@@ -1,0 +1,21 @@
+"""rmqtt_tpu — a TPU-native distributed MQTT broker framework.
+
+Re-implements the capabilities of the reference broker (rmqtt/rmqtt, Rust) with a
+TPU-accelerated subscription-routing core: the reference's CPU topic trie
+(`/root/reference/rmqtt/src/trie.rs`) and `Router::matches()`
+(`/root/reference/rmqtt/src/router.rs:65-112`) become a flattened level-token
+automaton in TPU HBM matched by a batched JAX/XLA kernel (`rmqtt_tpu.ops.match`),
+while the broker data plane (listeners, codec, sessions, QoS state machines,
+cluster RPC) runs on the host (`rmqtt_tpu.broker`).
+
+Layout (mirrors the reference's crate layering, see SURVEY.md §1):
+  core/      topic model + CPU trie oracle (reference semantics baseline)
+  ops/       TPU kernels: token encoding, batched wildcard match, retained scan
+  router/    Router interface + DefaultRouter (CPU) + XlaRouter (TPU north star)
+  parallel/  device-mesh sharded matching (jax.sharding / shard_map)
+  broker/    host data plane: codec, sessions, shared state, retain, hooks, ACL
+  cluster/   multi-node: broadcast + raft-replicated routing over host RPC
+  utils/     counters, rate counters, helpers
+"""
+
+__version__ = "0.1.0"

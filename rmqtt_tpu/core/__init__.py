@@ -1,0 +1,19 @@
+"""Core topic model and CPU reference structures (the correctness oracle).
+
+These mirror the semantics of the reference broker's topic layer
+(`/root/reference/rmqtt/src/topic.rs`, `/root/reference/rmqtt/src/trie.rs`)
+and serve as (a) the host-side data model for the broker and (b) the oracle
+that the TPU matcher in `rmqtt_tpu.ops` is differential-tested against.
+"""
+
+from rmqtt_tpu.core.topic import (
+    HASH,
+    PLUS,
+    filter_valid,
+    is_metadata,
+    match_filter,
+    parse_shared,
+    split_levels,
+    topic_valid,
+)
+from rmqtt_tpu.core.trie import RetainTree, TopicTree

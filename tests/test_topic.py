@@ -1,0 +1,101 @@
+"""Topic model tests.
+
+Behavior vectors mirror the reference's unit tests
+(`/root/reference/rmqtt/src/topic.rs:456-617`) — ported as behavior, not code.
+"""
+
+from rmqtt_tpu.core.topic import filter_valid, match_filter, parse_shared, split_levels, topic_valid
+
+
+def test_split():
+    assert split_levels("/a/b") == ["", "a", "b"]
+    assert split_levels("a/b/") == ["a", "b", ""]
+    assert split_levels("a") == ["a"]
+
+
+def test_filter_valid():
+    assert filter_valid("sport/tennis/#")
+    assert filter_valid("#")
+    assert filter_valid("+")
+    assert filter_valid("+/+")
+    assert filter_valid("/+")
+    assert filter_valid("sport/+/player1")
+    assert filter_valid("$SYS/#")
+    assert filter_valid("/x/y/z/")
+    # '#' must be last
+    assert not filter_valid("sport/#/x")
+    # partial wildcards in a level are invalid
+    assert not filter_valid("sport+")
+    assert not filter_valid("sport/ten#nis")
+    # metadata only at the first level
+    assert not filter_valid("a/$SYS/b")
+    assert not filter_valid("")
+
+
+def test_topic_valid():
+    assert topic_valid("sport/tennis")
+    assert topic_valid("$SYS/broker/uptime")
+    assert topic_valid("/a/b/")
+    assert not topic_valid("a/+/b")
+    assert not topic_valid("a/#")
+    assert not topic_valid("a/$x/b")
+    assert not topic_valid("")
+
+
+# --- matching vectors from reference topic.rs:586-617 ---
+def test_match_multiwildcard():
+    assert match_filter("sport/tennis/player1/#", "sport/tennis/player1")
+    assert match_filter("sport/tennis/player1/#", "sport/tennis/player1/ranking")
+    assert match_filter("sport/tennis/player1/#", "sport/tennis/player1/score/wimbledon")
+    assert match_filter("sport/#", "sport")
+
+
+def test_match_singlewildcard():
+    assert match_filter("sport/tennis/+", "sport/tennis/player1")
+    assert match_filter("sport/tennis/+", "sport/tennis/player2")
+    assert not match_filter("sport/tennis/+", "sport/tennis/player1/ranking")
+    assert not match_filter("sport/+", "sport")
+    assert match_filter("sport/+", "sport/")
+    assert match_filter("+/+", "/finance")
+    assert match_filter("/+", "/finance")
+    assert not match_filter("+", "/finance")
+
+
+def test_match_dollar_isolation():
+    assert not match_filter("#", "$SYS")
+    assert not match_filter("+/monitor/Clients", "$SYS/monitor/Clients")
+    assert match_filter("$SYS/#", "$SYS/")
+    assert match_filter("$SYS/#", "$SYS")
+    assert match_filter("$SYS/monitor/+", "$SYS/monitor/Clients")
+    assert not match_filter("#", "$SYS/monitor/Clients")
+
+
+def test_match_blank_levels():
+    # '+' matches a blank level (trie.rs test: /ddl/+/+ matches /ddl/22/)
+    assert match_filter("/ddl/+/+", "/ddl/22/")
+    assert match_filter("/x/y/z/+", "/x/y/z/")
+    assert match_filter("/x/y/z/#", "/x/y/z/")
+    assert match_filter("/x/y/z/", "/x/y/z/")
+    assert not match_filter("/ddl/+/1", "/ddl/22/")
+
+
+def test_match_exact():
+    assert match_filter("a/b/c", "a/b/c")
+    assert not match_filter("a/b/c", "a/b")
+    assert not match_filter("a/b", "a/b/c")
+    assert not match_filter("a/b/c", "a/b/x")
+
+
+def test_parse_shared():
+    import pytest
+
+    from rmqtt_tpu.core.topic import InvalidSharedFilter
+
+    assert parse_shared("$share/g1/sport/#") == ("g1", "sport/#")
+    assert parse_shared("$share/g/t") == ("g", "t")
+    assert parse_shared("sport/#") == (None, "sport/#")
+    assert parse_shared("$shared/g/t") == (None, "$shared/g/t")
+    # malformed $share filters are protocol errors (reference rejects them)
+    for bad in ["$share/", "$share/g", "$share//x", "$share/g/", "$share"]:
+        with pytest.raises(InvalidSharedFilter):
+            parse_shared(bad)

@@ -1,0 +1,174 @@
+"""TopicTree / RetainTree oracle tests.
+
+Vectors mirror the reference trie unit tests
+(`/root/reference/rmqtt/src/trie.rs:443-527`) plus a differential check of
+the trie against the direct matcher over randomized topics/filters.
+"""
+
+import random
+
+from rmqtt_tpu.core.topic import filter_valid, match_filter
+from rmqtt_tpu.core.trie import RetainTree, TopicTree
+
+
+def matched_values(tree, topic):
+    out = []
+    for _filter, vals in tree.matches(topic):
+        out.extend(vals)
+    return sorted(out)
+
+
+def test_tree_vectors():
+    t = TopicTree()
+    t.insert("/iot/b/x", 1)
+    t.insert("/iot/b/x", 2)
+    t.insert("/iot/b/y", 3)
+    t.insert("/iot/cc/dd", 4)
+    t.insert("/ddl/22/#", 5)
+    t.insert("/ddl/+/+", 6)
+    t.insert("/ddl/+/1", 7)
+    t.insert("/ddl/#", 8)
+    t.insert("/xyz/yy/zz", 7)
+    t.insert("/xyz", 8)
+
+    assert matched_values(t, "/iot/b/x") == [1, 2]
+    assert matched_values(t, "/iot/b/y") == [3]
+    assert matched_values(t, "/iot/cc/dd") == [4]
+    assert matched_values(t, "/xyz/yy/zz") == [7]
+    assert matched_values(t, "/ddl/22/1/2") == [5, 8]
+    assert matched_values(t, "/ddl/22/1") == [5, 6, 7, 8]
+    assert matched_values(t, "/ddl/22/") == [5, 6, 8]
+    assert matched_values(t, "/ddl/22") == [5, 8]
+
+    assert t.remove("/iot/b/x", 2)
+    assert t.remove("/xyz/yy/zz", 7)
+    assert not t.remove("/xyz", 123)
+    assert matched_values(t, "/xyz/yy/zz") == []
+    assert matched_values(t, "/iot/b/x") == [1]
+
+
+def test_tree_parent_hash_and_plus_blank():
+    t = TopicTree()
+    t.insert("/x/y/z/#", 1)
+    t.insert("/x/y/z/#", 2)
+    t.insert("/x/y/z/", 3)
+    assert matched_values(t, "/x/y/z/") == [1, 2, 3]
+    t.insert("/x/y/z/+", 4)
+    assert matched_values(t, "/x/y/z/2") == [1, 2, 4]
+    # parent match: /x/y/z matches /x/y/z/#
+    assert matched_values(t, "/x/y/z") == [1, 2]
+
+
+def test_tree_dollar_isolation():
+    t = TopicTree()
+    t.insert("#", 1)
+    t.insert("+/monitor/Clients", 2)
+    t.insert("$SYS/#", 3)
+    t.insert("$SYS/monitor/+", 4)
+    assert matched_values(t, "$SYS/monitor/Clients") == [3, 4]
+    assert matched_values(t, "other/monitor/Clients") == [1, 2]
+    assert matched_values(t, "$SYS") == [3]
+
+
+def test_tree_remove_prunes():
+    t = TopicTree()
+    t.insert("a/b/c", 1)
+    assert not t.is_empty()
+    assert t.remove("a/b/c", 1)
+    assert t.is_empty()
+    assert t.values_size() == 0
+
+
+def test_values_size_dedup():
+    t = TopicTree()
+    t.insert("a", 1)
+    t.insert("a", 1)
+    assert t.values_size() == 1
+
+
+def random_topic(rng, maxdepth=5, alphabet=("a", "b", "c", "", "$s")):
+    n = rng.randint(1, maxdepth)
+    return "/".join(rng.choice(alphabet) for _ in range(n))
+
+
+def random_filter(rng, maxdepth=5):
+    n = rng.randint(1, maxdepth)
+    levels = [rng.choice(["a", "b", "c", "", "+", "$s"]) for _ in range(n)]
+    if rng.random() < 0.4:
+        levels[-1] = "#"
+    return "/".join(levels)
+
+
+def test_differential_trie_vs_direct():
+    """Trie matching must agree with the direct matcher on random data."""
+    rng = random.Random(42)
+    filters = []
+    tree = TopicTree()
+    for i in range(300):
+        f = random_filter(rng)
+        if not filter_valid(f):
+            continue
+        filters.append((f, i))
+        tree.insert(f, i)
+    for _ in range(500):
+        topic = random_topic(rng)
+        expect = sorted(i for f, i in filters if match_filter(f, topic))
+        got = matched_values(tree, topic)
+        assert got == expect, f"topic={topic!r} got={got} expect={expect}"
+
+
+def test_retain_tree():
+    rt = RetainTree()
+    assert rt.insert("a/b/c", "m1") is None
+    assert rt.insert("a/b/d", "m2") is None
+    assert rt.insert("a/b", "m3") is None
+    assert rt.insert("$SYS/x", "m4") is None
+    assert rt.count() == 4
+    # overwrite returns previous
+    assert rt.insert("a/b/c", "m1b") == "m1"
+    assert rt.count() == 4
+
+    assert dict(rt.matches("a/b/+")) == {("a", "b", "c"): "m1b", ("a", "b", "d"): "m2"}
+    assert dict(rt.matches("a/#")) == {
+        ("a", "b"): "m3",
+        ("a", "b", "c"): "m1b",
+        ("a", "b", "d"): "m2",
+    }
+    # '#' parent match includes the node itself
+    assert dict(rt.matches("a/b/#")) == {
+        ("a", "b"): "m3",
+        ("a", "b", "c"): "m1b",
+        ("a", "b", "d"): "m2",
+    }
+    # $-isolation for wildcard-first filters
+    assert dict(rt.matches("#")) == {
+        ("a", "b"): "m3",
+        ("a", "b", "c"): "m1b",
+        ("a", "b", "d"): "m2",
+    }
+    assert dict(rt.matches("+/x")) == {}
+    assert dict(rt.matches("$SYS/#")) == {("$SYS", "x"): "m4"}
+    assert dict(rt.matches("$SYS/+")) == {("$SYS", "x"): "m4"}
+
+    assert rt.get("a/b") == "m3"
+    assert rt.remove("a/b") == "m3"
+    assert rt.get("a/b") is None
+    assert rt.count() == 3
+
+
+def test_retain_differential():
+    """RetainTree.matches(filter) must equal direct match over stored topics."""
+    rng = random.Random(7)
+    rt = RetainTree()
+    topics = set()
+    for i in range(200):
+        tp = random_topic(rng)
+        topics.add(tp)
+        rt.insert(tp, i)
+    for _ in range(300):
+        f = random_filter(rng)
+        if not filter_valid(f):
+            continue
+        expect = sorted(t for t in topics if match_filter(f, t))
+        got = sorted("/".join(levels) for levels, _ in rt.matches(f))
+        assert got == expect, f"filter={f!r} got={got} expect={expect}"
