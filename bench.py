@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Routing benchmark: PUBLISH routes/sec + p99 match latency vs CPU baseline.
+
+Implements the five configs of BASELINE.json. The reference publishes no
+routing-match microbenchmark (BASELINE.md), so the baseline is our own CPU
+``DefaultRouter``-equivalent (the TopicTree trie oracle, mirroring
+`/root/reference/rmqtt/src/router.rs:174-265` + `trie.rs:288-408`), measured
+on the *same* filter set over a topic subsample; the TPU side runs the
+batched automaton matcher end-to-end (host encode → kernel → fid decode).
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+Per-config detail goes to stderr.
+
+Usage:
+  python bench.py              # default: configs 1-3 (headline = config 3)
+  python bench.py --full       # adds configs 4-5 (10M subs; slower build)
+  python bench.py --smoke      # tiny config 1 only (CI / CPU-friendly)
+  python bench.py --config N   # run just config N (headline = it)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- generators
+
+
+def gen_exact(rng, n):
+    """Config 1: exact-match filters, no wildcards (depth 3-5)."""
+    filters = set()
+    while len(filters) < n:
+        depth = rng.randint(3, 5)
+        filters.add("/".join(f"l{d}n{rng.randrange(max(4, n >> (8 - d)))}" for d in range(depth)))
+    return sorted(filters)
+
+
+def gen_single_plus(rng, n):
+    """Config 2: single-level '+' wildcards (depth 3-5, one + each)."""
+    filters = set()
+    while len(filters) < n:
+        depth = rng.randint(3, 5)
+        levels = [f"l{d}n{rng.randrange(max(4, n >> (8 - d)))}" for d in range(depth)]
+        levels[rng.randrange(depth)] = "+"
+        filters.add("/".join(levels))
+    return sorted(filters)
+
+
+VOCAB6 = [50, 80, 100, 150, 200, 400]  # per-level vocabulary of the 6-level tree
+
+
+def _tree_topic(rng, depth=6):
+    return "/".join(f"v{d}_{rng.randrange(VOCAB6[d])}" for d in range(depth))
+
+
+def gen_mixed(rng, n, shared_frac=0.0):
+    """Configs 3/4: mixed +/# wildcards over a 6-level topic tree."""
+    filters = set()
+    while len(filters) < n:
+        depth = rng.randint(2, 6)
+        levels = [f"v{d}_{rng.randrange(VOCAB6[d])}" for d in range(depth)]
+        r = rng.random()
+        if r < 0.35:  # sprinkle +
+            for _ in range(rng.randint(1, 2)):
+                levels[rng.randrange(depth)] = "+"
+        if r >= 0.25 and r < 0.55:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if shared_frac and rng.random() < shared_frac:
+            f = "$share/g%d/%s" % (rng.randrange(16), f)
+        filters.add(f)
+    return sorted(filters)
+
+
+def gen_topics_uniform(rng, n, depth=6):
+    return [_tree_topic(rng, depth) for _ in range(n)]
+
+
+def gen_topics_zipf(rng, n, depth=6, a=1.3):
+    """Zipf-skewed publish stream over the topic tree (config 4)."""
+    nprng = np.random.default_rng(rng.randrange(2**31))
+    out = []
+    for _ in range(n):
+        ranks = nprng.zipf(a, size=depth)
+        out.append("/".join(f"v{d}_{(int(ranks[d]) - 1) % VOCAB6[d]}" for d in range(depth)))
+    return out
+
+
+# ---------------------------------------------------------------- measurement
+
+
+def build_tpu_table(filters):
+    from rmqtt_tpu.core.topic import parse_shared
+    from rmqtt_tpu.ops.encode import FilterTable
+
+    table = FilterTable()
+    fids = {}
+    t0 = time.perf_counter()
+    for f in filters:
+        _, stripped = parse_shared(f)
+        fids[table.add(stripped)] = stripped
+    log(f"  table build: {len(filters)} filters in {time.perf_counter() - t0:.2f}s "
+        f"(cap={table.capacity}, L={table.max_levels}, vocab={len(table.tokens)})")
+    return table, fids
+
+
+def build_cpu_tree(filters):
+    from rmqtt_tpu.core.topic import parse_shared
+    from rmqtt_tpu.core.trie import TopicTree
+
+    tree = TopicTree()
+    t0 = time.perf_counter()
+    for i, f in enumerate(filters):
+        _, stripped = parse_shared(f)
+        tree.insert(stripped, i)
+    log(f"  trie build: {time.perf_counter() - t0:.2f}s")
+    return tree
+
+
+def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8):
+    """End-to-end topics/sec + per-batch latency through TpuMatcher.match."""
+    from rmqtt_tpu.ops.match import TpuMatcher
+
+    matcher = TpuMatcher(table)
+    batches = [topics[i : i + batch_size] for i in range(0, len(topics), batch_size)]
+    batches = [b for b in batches if len(b) == batch_size]
+    if len(batches) < warmup + min_batches:
+        batches = batches * ((warmup + min_batches) // max(1, len(batches)) + 1)
+    # warmup (compile)
+    t0 = time.perf_counter()
+    for b in batches[:warmup]:
+        matcher.match(b)
+    log(f"  tpu warmup/compile: {time.perf_counter() - t0:.2f}s")
+    lat = []
+    routes = 0
+    done = 0
+    t_start = time.perf_counter()
+    for b in batches[warmup:]:
+        t1 = time.perf_counter()
+        rows = matcher.match(b)
+        lat.append(time.perf_counter() - t1)
+        routes += sum(len(r) for r in rows)
+        done += len(b)
+    total = time.perf_counter() - t_start
+    return {
+        "topics_per_sec": done / total,
+        "routes_per_sec": routes / total,
+        "routes": routes,
+        "topics": done,
+        "p50_ms": float(np.percentile(lat, 50) * 1e3),
+        "p99_ms": float(np.percentile(lat, 99) * 1e3),
+        "batch_size": batch_size,
+    }
+
+
+def measure_cpu(tree, topics, sample, time_budget_s=20.0):
+    """CPU trie matches/sec over a subsample of the same topic stream."""
+    sub = topics[:sample]
+    t0 = time.perf_counter()
+    routes = 0
+    done = 0
+    for topic in sub:
+        for _f, vals in tree.matches(topic):
+            routes += len(vals)
+        done += 1
+        if time.perf_counter() - t0 > time_budget_s:
+            break
+    total = time.perf_counter() - t0
+    return {
+        "topics_per_sec": done / total,
+        "routes_per_sec": routes / total,
+        "topics": done,
+        "routes": routes,
+    }
+
+
+def spot_check(table, fids, tree, topics, n=32):
+    """Correctness: TPU fids ≡ trie values on a topic sample."""
+    from rmqtt_tpu.ops.match import TpuMatcher
+
+    matcher = TpuMatcher(table)
+    sample = topics[:n]
+    rows = matcher.match(sample)
+    for topic, row in zip(sample, rows):
+        tpu_filters = sorted(fids[fid] for fid in row.tolist())
+        cpu_filters = sorted(
+            fids_str for _lv, vals in tree.matches(topic) for fids_str in ["/".join(_lv)] * len(vals)
+        )
+        assert tpu_filters == cpu_filters, f"mismatch on {topic!r}:\n{tpu_filters}\nvs\n{cpu_filters}"
+    log(f"  spot check: {n} topics agree with CPU oracle")
+
+
+# ---------------------------------------------------------------- configs
+
+
+def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
+    log(f"[{name}] {len(filters)} subs, {len(topics)} publish topics")
+    table, fids = build_tpu_table(filters)
+    tree = build_cpu_tree(filters)
+    spot_check(table, fids, tree, topics)
+    tpu = measure_tpu(table, topics, batch_size)
+    cpu = measure_cpu(tree, topics, cpu_sample)
+    res = {"name": name, "tpu": tpu, "cpu": cpu, "speedup": tpu["topics_per_sec"] / cpu["topics_per_sec"]}
+    if retained is not None:
+        res["retained"] = run_retained(table, retained, topics)
+    log(
+        f"[{name}] TPU {tpu['topics_per_sec']:.0f} topics/s ({tpu['routes_per_sec']:.0f} routes/s, "
+        f"p50 {tpu['p50_ms']:.1f}ms p99 {tpu['p99_ms']:.1f}ms) | "
+        f"CPU {cpu['topics_per_sec']:.0f} topics/s | speedup {res['speedup']:.1f}x"
+    )
+    return res
+
+
+def run_retained(sub_table, retained_topics, publish_topics):
+    """Config 5 extra: concurrent retained-scan (SUBSCRIBE) + publish routing."""
+    from rmqtt_tpu.ops.encode import FilterTable
+    from rmqtt_tpu.ops.match import TpuMatcher
+    from rmqtt_tpu.ops.retained import RetainedScanner
+
+    rt = FilterTable()
+    t0 = time.perf_counter()
+    for t in retained_topics:
+        rt.add(t)
+    log(f"  retained table: {len(retained_topics)} topics in {time.perf_counter() - t0:.2f}s")
+    scanner = RetainedScanner(rt)
+    matcher = TpuMatcher(sub_table)
+    # interleave: one publish batch + one subscribe-scan batch per round
+    sub_filters = ["/".join(["+"] * k) + "/#" for k in range(1, 5)] * 16
+    pb, sb = 1024, 64
+    scanner.scan(sub_filters[:sb])
+    matcher.match(publish_topics[:pb])  # warm
+    t0 = time.perf_counter()
+    rounds = 8
+    for r in range(rounds):
+        matcher.match(publish_topics[r * pb : (r + 1) * pb])
+        scanner.scan(sub_filters[:sb])
+    total = time.perf_counter() - t0
+    return {
+        "publish_topics_per_sec": rounds * pb / total,
+        "subscribe_scans_per_sec": rounds * sb / total,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
+    ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-5")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    rng = random.Random(args.seed)
+    platform = jax.devices()[0].platform
+    log(f"jax devices: {jax.devices()} (platform={platform})")
+
+    results = {}
+
+    def want(i):
+        if args.smoke:
+            return i == 1
+        if args.config is not None:
+            return i == args.config
+        return i <= 3 or args.full
+
+    if want(1):
+        n = 1000 if not args.smoke else 200
+        filters = gen_exact(rng, n)
+        # ~50% of publishes hit a subscribed topic
+        topics = [rng.choice(filters) if rng.random() < 0.5 else _tree_topic(rng, 4) for _ in range(4096)]
+        results["cfg1_exact_1k"] = run_config("cfg1_exact_1k", filters, topics, 1024, 1024)
+
+    if want(2):
+        filters = gen_single_plus(rng, 100_000)
+        topics = gen_topics_uniform(rng, 20_000, depth=4)
+        # depth 3-5 filters over l{d}n{...} names: generate matching-shape topics
+        topics = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5))) for _ in range(20_000)]
+        results["cfg2_plus_100k"] = run_config("cfg2_plus_100k", filters, topics, 2048, 512)
+
+    if want(3):
+        filters = gen_mixed(rng, 1_000_000)
+        topics = gen_topics_uniform(rng, 32_768)
+        results["cfg3_mixed_1m"] = run_config("cfg3_mixed_1m", filters, topics, 4096, 256)
+
+    if want(4):
+        filters = gen_mixed(rng, 10_000_000, shared_frac=0.1)
+        topics = gen_topics_zipf(rng, 16_384)
+        results["cfg4_shared_10m_zipf"] = run_config("cfg4_shared_10m_zipf", filters, topics, 1024, 64)
+
+    if want(5):
+        filters = gen_mixed(rng, 10_000_000, shared_frac=0.05)
+        topics = gen_topics_zipf(rng, 16_384)
+        retained = list({_tree_topic(rng, rng.randint(3, 6)) for _ in range(1_000_000)})
+        results["cfg5_retained_10m"] = run_config(
+            "cfg5_retained_10m", filters, topics, 1024, 64, retained=retained
+        )
+
+    # headline = the largest routing config that ran
+    for headline in ["cfg4_shared_10m_zipf", "cfg5_retained_10m", "cfg3_mixed_1m", "cfg2_plus_100k", "cfg1_exact_1k"]:
+        if headline in results:
+            break
+    r = results[headline]
+    print(
+        json.dumps(
+            {
+                "metric": f"publish_route_topics_per_sec[{headline}]",
+                "value": round(r["tpu"]["topics_per_sec"], 1),
+                "unit": "topics/s",
+                "vs_baseline": round(r["speedup"], 2),
+                "routes_per_sec": round(r["tpu"]["routes_per_sec"], 1),
+                "p99_ms": round(r["tpu"]["p99_ms"], 2),
+                "platform": platform,
+                "configs": {
+                    k: {
+                        "tpu_topics_per_sec": round(v["tpu"]["topics_per_sec"], 1),
+                        "cpu_topics_per_sec": round(v["cpu"]["topics_per_sec"], 1),
+                        "speedup": round(v["speedup"], 2),
+                        "p99_ms": round(v["tpu"]["p99_ms"], 2),
+                    }
+                    for k, v in results.items()
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,11 @@ def split_levels(topic: str) -> list[str]:
     return topic.split(SEP)
 
 
+def as_levels(topic: str | Sequence[str]) -> list[str]:
+    """Normalize a topic given as string or level sequence to a level list."""
+    return split_levels(topic) if isinstance(topic, str) else list(topic)
+
+
 def _level_valid(level: str, pos: int) -> bool:
     if level in (PLUS, HASH, ""):
         return True
@@ -60,12 +65,9 @@ def _level_valid(level: str, pos: int) -> bool:
 
 def filter_valid(filter_: str | Sequence[str]) -> bool:
     """Validate a subscription topic filter (topic.rs ``Topic::is_valid``)."""
-    if isinstance(filter_, str):
-        if not filter_:
-            return False  # MQTT-5.0 4.7.3: topic filters must be ≥1 char
-        levels = split_levels(filter_)
-    else:
-        levels = list(filter_)
+    if isinstance(filter_, str) and not filter_:
+        return False  # MQTT-5.0 4.7.3: topic filters must be ≥1 char
+    levels = as_levels(filter_)
     if not levels:
         return False
     for i, lev in enumerate(levels):
@@ -78,12 +80,9 @@ def filter_valid(filter_: str | Sequence[str]) -> bool:
 
 def topic_valid(topic: str | Sequence[str]) -> bool:
     """Validate a publish topic name: no wildcards, ``$`` only first."""
-    if isinstance(topic, str):
-        if not topic:
-            return False  # MQTT-5.0 4.7.3: topic names must be ≥1 char
-        levels = split_levels(topic)
-    else:
-        levels = list(topic)
+    if isinstance(topic, str) and not topic:
+        return False  # MQTT-5.0 4.7.3: topic names must be ≥1 char
+    levels = as_levels(topic)
     if not levels:
         return False
     for i, lev in enumerate(levels):
@@ -99,8 +98,8 @@ def match_filter(filter_: str | Sequence[str], topic: str | Sequence[str]) -> bo
 
     Canonical routing-trie semantics (trie.rs ``MatchedIter``, :327-408).
     """
-    f = split_levels(filter_) if isinstance(filter_, str) else list(filter_)
-    t = split_levels(topic) if isinstance(topic, str) else list(topic)
+    f = as_levels(filter_)
+    t = as_levels(topic)
     if not f or not t:
         return False
     # $-topic isolation from wildcard-first filters (trie.rs:342-347).
@@ -130,8 +129,10 @@ def parse_shared(topic_filter: str) -> Tuple[Optional[str], str]:
 
     Returns ``(None, topic_filter)`` when not a shared subscription. Raises
     :class:`InvalidSharedFilter` on a malformed ``$share`` filter (missing
-    group or filter), mirroring the reference's Subscribe parsing which
-    rejects these (rmqtt/src/types.rs:554-560).
+    group or filter), as the reference's Subscribe parsing does
+    (rmqtt/src/types.rs:554-566) — with one deliberate divergence: the
+    reference's ``splitn`` accepts an *empty* share group (``$share//x``),
+    which violates MQTT-5.0 §4.8.2 (ShareName must be ≥1 char); we reject it.
     """
     if topic_filter != SHARED_PREFIX and not topic_filter.startswith(SHARED_PREFIX + SEP):
         return None, topic_filter

@@ -20,13 +20,9 @@ from __future__ import annotations
 
 from typing import Dict, Generic, Hashable, Iterator, List, Optional, Sequence, Tuple, TypeVar
 
-from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
+from rmqtt_tpu.core.topic import HASH, PLUS, as_levels, is_metadata
 
 V = TypeVar("V", bound=Hashable)
-
-
-def _levels(topic: str | Sequence[str]) -> List[str]:
-    return split_levels(topic) if isinstance(topic, str) else list(topic)
 
 
 class _Node(Generic[V]):
@@ -53,7 +49,7 @@ class TopicTree(Generic[V]):
 
     def insert(self, topic_filter: str | Sequence[str], value: V) -> None:
         node = self._root
-        for lev in _levels(topic_filter):
+        for lev in as_levels(topic_filter):
             nxt = node.branches.get(lev)
             if nxt is None:
                 nxt = _Node()
@@ -65,7 +61,7 @@ class TopicTree(Generic[V]):
 
     def remove(self, topic_filter: str | Sequence[str], value: V) -> bool:
         """Remove one value; prunes empty nodes (trie.rs:129-149)."""
-        levels = _levels(topic_filter)
+        levels = as_levels(topic_filter)
         path: List[Tuple[_Node[V], str]] = []
         node = self._root
         for lev in levels:
@@ -102,7 +98,7 @@ class TopicTree(Generic[V]):
         child-``#`` parent match; skip wildcard branches at the root for
         ``$``-topics.
         """
-        path = _levels(topic)
+        path = as_levels(topic)
         out: List[Tuple[Tuple[str, ...], List[V]]] = []
         self._match(self._root, path, 0, [], out)
         return out
@@ -187,7 +183,7 @@ class RetainTree(Generic[V]):
     def insert(self, topic: str | Sequence[str], value: V) -> Optional[V]:
         """Store/overwrite; returns the previous value if any."""
         node = self._root
-        for lev in _levels(topic):
+        for lev in as_levels(topic):
             nxt = node.branches.get(lev)
             if nxt is None:
                 nxt = _Node()
@@ -201,7 +197,7 @@ class RetainTree(Generic[V]):
         return prev
 
     def remove(self, topic: str | Sequence[str]) -> Optional[V]:
-        levels = _levels(topic)
+        levels = as_levels(topic)
         path: List[Tuple[_Node[V], str]] = []
         node = self._root
         for lev in levels:
@@ -225,7 +221,7 @@ class RetainTree(Generic[V]):
 
     def get(self, topic: str | Sequence[str]) -> Optional[V]:
         node = self._root
-        for lev in _levels(topic):
+        for lev in as_levels(topic):
             node = node.branches.get(lev)  # type: ignore[assignment]
             if node is None:
                 return None
@@ -236,7 +232,7 @@ class RetainTree(Generic[V]):
 
     def matches(self, topic_filter: str | Sequence[str]) -> List[Tuple[Tuple[str, ...], V]]:
         """All stored (topic_levels, value) whose topic matches ``topic_filter``."""
-        filt = _levels(topic_filter)
+        filt = as_levels(topic_filter)
         out: List[Tuple[Tuple[str, ...], V]] = []
         self._rmatch(self._root, filt, 0, [], out)
         return out

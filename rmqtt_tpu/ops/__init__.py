@@ -1,0 +1,20 @@
+"""TPU kernels: the flattened topic automaton and batched wildcard matching.
+
+This package is the TPU-native replacement for the reference broker's
+pointer-chasing trie DFS (`/root/reference/rmqtt/src/trie.rs:288-408`): the
+set of subscription filters is flattened into a padded level-token matrix
+resident in device HBM (`FilterTable`), and `Router::matches()`
+(`/root/reference/rmqtt/src/router.rs:174-265`) becomes a single batched
+XLA program that matches B publish topics against all F filters at once,
+returning packed subscriber-filter bitmaps (`ops.match`).
+"""
+
+from rmqtt_tpu.ops.encode import (
+    HASH_TOK,
+    PAD_TOK,
+    PLUS_TOK,
+    UNK_TOK,
+    FilterTable,
+    TokenDict,
+)
+from rmqtt_tpu.ops.match import TpuMatcher, unpack_bitmap

@@ -1,0 +1,217 @@
+"""Host-side token encoding: topic levels → int tokens, filters → table rows.
+
+The level-token encoding replaces the reference's per-node string keys
+(`/root/reference/rmqtt/src/trie.rs:84-87`, branches keyed by ``Level``):
+
+- every distinct level string used by any *filter* is interned to an int id;
+- reserved ids: ``PAD_TOK`` (0, beyond a filter/topic's length), ``PLUS_TOK``
+  (1, the ``+`` wildcard), ``HASH_TOK`` (2, the ``#`` wildcard), ``UNK_TOK``
+  (3, a publish-topic level never seen in any filter — it can only be matched
+  by wildcards);
+- a publish topic is encoded with dictionary *lookup* (unknown → ``UNK_TOK``),
+  so the kernel never needs strings.
+
+``FilterTable`` is the flattened automaton: a fixed-capacity, padded
+``[capacity, max_levels]`` int32 token matrix plus per-row metadata
+(total level count, prefix length before ``#``, has-``#``, wildcard-first).
+Rows are allocated/freed by the router as subscriptions churn
+(`/root/reference/rmqtt/src/router.rs:434-496` add/remove); device arrays are
+re-materialised lazily on the next match after a mutation (double-buffered:
+the host staging copy is numpy, the device copy is donated on refresh).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
+
+PAD_TOK = 0
+PLUS_TOK = 1
+HASH_TOK = 2
+UNK_TOK = 3
+_FIRST_TOK = 4
+
+_MIN_CAPACITY = 1024
+
+
+class TokenDict:
+    """Interning dictionary: level string ↔ int token id."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {}
+        self._strs: List[str] = []
+
+    def intern(self, level: str) -> int:
+        tid = self._ids.get(level)
+        if tid is None:
+            tid = _FIRST_TOK + len(self._strs)
+            self._ids[level] = tid
+            self._strs.append(level)
+        return tid
+
+    def lookup(self, level: str) -> int:
+        return self._ids.get(level, UNK_TOK)
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+class FilterTable:
+    """The flattened subscription automaton (host staging side).
+
+    Rows are filter slots; the router keys rows by filter id (``fid``). The
+    table only stores the *topic-filter shape*; relations (fid → clients) stay
+    host-side, mirroring the reference's split between the trie and
+    ``AllRelationsMap`` (`/root/reference/rmqtt/src/router.rs:121-139`).
+    """
+
+    def __init__(self, capacity: int = _MIN_CAPACITY, max_levels: int = 8) -> None:
+        self.capacity = _pow2_at_least(capacity, _MIN_CAPACITY)
+        self.max_levels = max_levels
+        self._alloc(self.capacity, self.max_levels)
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self.tokens = TokenDict()
+        self.size = 0
+        # bumped on every mutation; device mirrors key their cache on it
+        self.version = 0
+
+    def _alloc(self, cap: int, lvl: int) -> None:
+        self.tok = np.zeros((cap, lvl), dtype=np.int32)
+        self.flen = np.full((cap,), -1, dtype=np.int32)
+        self.prefix_len = np.zeros((cap,), dtype=np.int32)
+        self.has_hash = np.zeros((cap,), dtype=bool)
+        self.first_wild = np.zeros((cap,), dtype=bool)
+        # row's first level is a $-metadata level (used when rows are stored
+        # *topic names*, i.e. the retained-scan direction)
+        self.row_dollar = np.zeros((cap,), dtype=bool)
+
+    def _grow(self, need_rows: int, need_levels: int) -> None:
+        new_cap = _pow2_at_least(max(need_rows, self.capacity), _MIN_CAPACITY)
+        new_lvl = max(need_levels, self.max_levels)
+        if new_cap == self.capacity and new_lvl == self.max_levels:
+            return
+        old = (self.tok, self.flen, self.prefix_len, self.has_hash, self.first_wild, self.row_dollar)
+        old_cap, old_lvl = self.capacity, self.max_levels
+        self._alloc(new_cap, new_lvl)
+        self.tok[:old_cap, :old_lvl] = old[0]
+        self.flen[:old_cap] = old[1]
+        self.prefix_len[:old_cap] = old[2]
+        self.has_hash[:old_cap] = old[3]
+        self.first_wild[:old_cap] = old[4]
+        self.row_dollar[:old_cap] = old[5]
+        if new_cap > old_cap:
+            self._free = list(range(new_cap - 1, old_cap - 1, -1)) + self._free
+        self.capacity, self.max_levels = new_cap, new_lvl
+
+    def add(self, topic_filter: str | Sequence[str]) -> int:
+        """Insert a (validated) filter; returns its row id (fid)."""
+        levels = split_levels(topic_filter) if isinstance(topic_filter, str) else list(topic_filter)
+        nlev = len(levels)
+        if not self._free or nlev > self.max_levels:
+            self._grow(self.size + 1, nlev)
+        fid = self._free.pop()
+        hh = levels[-1] == HASH
+        prefix = nlev - 1 if hh else nlev
+        row = self.tok[fid]
+        row[:] = PAD_TOK
+        for i, lev in enumerate(levels):
+            if lev == PLUS:
+                row[i] = PLUS_TOK
+            elif lev == HASH:
+                row[i] = HASH_TOK
+            else:
+                row[i] = self.tokens.intern(lev)
+        self.flen[fid] = nlev
+        self.prefix_len[fid] = prefix
+        self.has_hash[fid] = hh
+        self.first_wild[fid] = levels[0] in (PLUS, HASH)
+        self.row_dollar[fid] = bool(levels[0]) and is_metadata(levels[0])
+        self.size += 1
+        self.version += 1
+        return fid
+
+    def remove(self, fid: int) -> None:
+        if self.flen[fid] < 0:
+            raise KeyError(f"fid {fid} not active")
+        self.tok[fid, :] = PAD_TOK
+        self.flen[fid] = -1
+        self.prefix_len[fid] = 0
+        self.has_hash[fid] = False
+        self.first_wild[fid] = False
+        self.row_dollar[fid] = False
+        self._free.append(fid)
+        self.size -= 1
+        self.version += 1
+
+    def encode_topics(
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Encode publish topics → (ttok [B, L], tlen [B], tdollar [B]).
+
+        Topics deeper than ``max_levels`` are truncated in the token matrix but
+        keep their true length — only ``#``-filters (whose prefix fits in
+        ``max_levels`` by construction) can match them, and those compare
+        prefix levels only.
+        """
+        batch = len(topics)
+        b = pad_batch_to or batch
+        lvl = self.max_levels
+        ttok = np.zeros((b, lvl), dtype=np.int32)
+        tlen = np.zeros((b,), dtype=np.int32)
+        tdollar = np.zeros((b,), dtype=bool)
+        for j, topic in enumerate(topics):
+            levels = split_levels(topic) if isinstance(topic, str) else list(topic)
+            tlen[j] = len(levels)
+            tdollar[j] = bool(levels[0]) and is_metadata(levels[0])
+            lookup = self.tokens.lookup
+            for i, lev in enumerate(levels[:lvl]):
+                ttok[j, i] = lookup(lev)
+        # padded rows: a '#' filter (prefix_len 0) would match tlen 0, so mark
+        # padding with tlen = -2 — no length rule can pass then.
+        if b > batch:
+            tlen[batch:] = -2
+        return ttok, tlen, tdollar
+
+    def encode_filters(
+        self, filters: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Encode wildcard *filters* as a batch (the retained-scan direction).
+
+        Returns ``(ftok [B, L], flen [B], fprefix [B], fhash [B], fwild [B])``.
+        Levels unknown to the dictionary map to ``UNK_TOK`` (they can only
+        self-match via the filter's own wildcards).
+        """
+        batch = len(filters)
+        b = pad_batch_to or batch
+        lvl = self.max_levels
+        ftok = np.zeros((b, lvl), dtype=np.int32)
+        flen = np.full((b,), -2, dtype=np.int32)
+        fprefix = np.full((b,), lvl + 1, dtype=np.int32)
+        fhash = np.zeros((b,), dtype=bool)
+        fwild = np.zeros((b,), dtype=bool)
+        for j, f in enumerate(filters):
+            levels = split_levels(f) if isinstance(f, str) else list(f)
+            hh = levels[-1] == HASH
+            flen[j] = len(levels)
+            fprefix[j] = len(levels) - 1 if hh else len(levels)
+            fhash[j] = hh
+            fwild[j] = levels[0] in (PLUS, HASH)
+            lookup = self.tokens.lookup
+            for i, lev in enumerate(levels[:lvl]):
+                if lev == PLUS:
+                    ftok[j, i] = PLUS_TOK
+                elif lev == HASH:
+                    ftok[j, i] = HASH_TOK
+                else:
+                    ftok[j, i] = lookup(lev)
+        return ftok, flen, fprefix, fhash, fwild

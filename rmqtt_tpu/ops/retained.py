@@ -1,0 +1,54 @@
+"""TPU retained-message topic scan (the SUBSCRIBE-side kernel).
+
+The reference scans its ``RetainTree`` on every SUBSCRIBE to replay retained
+messages (`/root/reference/rmqtt/src/retain.rs:373-450`,
+`rmqtt/src/session.rs:1930+`). Here the stored retained *topic names* are rows
+of a ``FilterTable`` in HBM and a batch of newly-subscribed wildcard filters
+is resolved against all of them in one inverse-match kernel launch
+(`ops.match.match_retained_impl`) — the same automaton reused in the other
+direction, per the north star (BASELINE.json: "retained-message topic lookup
+reuses the same kernel").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import numpy as np
+
+from rmqtt_tpu.ops.encode import FilterTable
+from rmqtt_tpu.ops.match import _match_retained, unpack_bitmap
+
+
+class RetainedScanner:
+    """Device mirror of a retained-topics table + batched inverse match."""
+
+    def __init__(self, table: FilterTable, chunk: int = 1 << 16, device=None) -> None:
+        self.table = table
+        self.chunk = chunk
+        self.device = device
+        self._dev_version = -1
+        self._dev_arrays = None
+
+    def _refresh(self):
+        t = self.table
+        if self._dev_version != t.version or self._dev_arrays is None:
+            put = functools.partial(jax.device_put, device=self.device) if self.device else jax.device_put
+            self._dev_arrays = tuple(put(a) for a in (t.tok, t.flen, t.row_dollar))
+            self._dev_version = t.version
+        return self._dev_arrays
+
+    def scan_encoded(self, ftok, flen, fprefix, fhash, fwild) -> jax.Array:
+        rtok, rlen, rdollar = self._refresh()
+        nchunks = max(1, self.table.capacity // self.chunk)
+        return _match_retained(rtok, rlen, rdollar, ftok, flen, fprefix, fhash, fwild, nchunks=nchunks)
+
+    def scan(self, filters: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
+        """→ per-filter arrays of matched retained-topic row ids."""
+        b = len(filters)
+        padded = 1 << (b - 1).bit_length() if (pad_to_pow2 and b > 1) else b
+        enc = self.table.encode_filters(filters, pad_batch_to=padded)
+        packed = np.asarray(self.scan_encoded(*enc))
+        return unpack_bitmap(packed[:b], nrows=self.table.capacity)

@@ -1,0 +1,15 @@
+"""Multi-device parallelism: sharding the automaton over a TPU mesh.
+
+The reference scales routing by replicating the route table per node (raft
+mode) or sharding it per node with scatter-gather (broadcast mode) — SURVEY.md
+§2.4. On TPU the same two strategies map to a 2-D device mesh:
+
+- ``dp`` (data parallel): the publish batch is sharded — each device matches
+  its slice of topics (raft-mode analogue: table replicated, matching local).
+- ``fp`` (filter parallel): the filter table is sharded — each device matches
+  all topics against its slice of filters and the per-topic results are
+  combined with XLA collectives over ICI (broadcast-mode analogue:
+  scatter-gather, `rmqtt-cluster-broadcast/src/shared.rs:367-520`).
+"""
+
+from rmqtt_tpu.parallel.sharded import ShardedMatcher, make_mesh

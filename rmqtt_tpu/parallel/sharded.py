@@ -1,0 +1,110 @@
+"""Sharded batched matching over a `jax.sharding.Mesh`.
+
+Implements the TPU-native equivalents of the reference's two cluster routing
+strategies (SURVEY.md §2.4 items 3 & 4) inside one pod slice:
+
+- topics sharded over the ``dp`` mesh axis (replicated-table / raft analogue,
+  `rmqtt-cluster-raft/src/router.rs:199-201`: match is local, no collective);
+- the filter table sharded over the ``fp`` mesh axis (scatter-gather /
+  broadcast analogue, `rmqtt-cluster-broadcast/src/shared.rs:412-520`): every
+  device matches the full (local) topic slice against its filter-row slice;
+  per-topic aggregate results (match counts, shared-group candidates) are
+  combined with `lax.psum` over ICI rather than gRPC fan-out.
+
+The packed bitmap stays sharded over ``fp`` — the fan-out host only pulls the
+shard(s) owning the sessions it delivers to, which is exactly the reference's
+"relations stay on the owning node" delivery split (`SubRelationsMap` keyed
+by node, types.rs:485-486).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rmqtt_tpu.ops.encode import FilterTable
+from rmqtt_tpu.ops.match import DEFAULT_CHUNK, match_packed_impl
+
+
+def make_mesh(devices=None, dp: int = 1, fp: Optional[int] = None) -> Mesh:
+    """Build a (dp, fp) mesh over the given (or all) devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if fp is None:
+        fp = n // dp
+    assert dp * fp == n, f"dp({dp}) * fp({fp}) != ndevices({n})"
+    return Mesh(np.asarray(devices).reshape(dp, fp), ("dp", "fp"))
+
+
+class ShardedMatcher:
+    """Filter table sharded over ``fp``, topic batch sharded over ``dp``.
+
+    One jitted step matches the whole batch and returns:
+      - packed bitmaps, sharded ``P('dp', 'fp')`` (stay on device), and
+      - exact per-topic match counts, via ``psum`` over ``fp`` (ICI).
+    """
+
+    def __init__(self, table: FilterTable, mesh: Mesh, chunk: int = DEFAULT_CHUNK) -> None:
+        self.table = table
+        self.mesh = mesh
+        self.fp = mesh.shape["fp"]
+        self.chunk = chunk
+        self._dev_version = -1
+        self._dev_arrays = None
+        if table.capacity % (self.fp * 32) != 0:
+            raise ValueError("table capacity must divide fp*32")
+        self._step = self._build_step()
+
+    def _build_step(self):
+        mesh = self.mesh
+        local_cap = self.table.capacity // self.fp
+        nchunks = max(1, local_cap // self.chunk)
+        fspec = (P("fp", None), P("fp"), P("fp"), P("fp"), P("fp"))
+        tspec = (P("dp", None), P("dp"), P("dp"))
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=fspec + tspec,
+            out_specs=(P("dp", "fp"), P("dp")),
+        )
+        def step(ftok, flen, pl, hh, fw, ttok, tlen, td):
+            packed = match_packed_impl(ftok, flen, pl, hh, fw, ttok, tlen, td, nchunks)
+            counts = jnp.sum(lax.population_count(packed).astype(jnp.int32), axis=1)
+            counts = lax.psum(counts, "fp")  # ICI all-reduce of per-topic totals
+            return packed, counts
+
+        return jax.jit(step)
+
+    def _refresh(self):
+        t = self.table
+        if self._dev_version != t.version or self._dev_arrays is None:
+            shard = lambda arr, spec: jax.device_put(arr, NamedSharding(self.mesh, spec))
+            self._dev_arrays = (
+                shard(t.tok, P("fp", None)),
+                shard(t.flen, P("fp")),
+                shard(t.prefix_len, P("fp")),
+                shard(t.has_hash, P("fp")),
+                shard(t.first_wild, P("fp")),
+            )
+            self._dev_version = t.version
+        return self._dev_arrays
+
+    def match_encoded(
+        self, ttok: np.ndarray, tlen: np.ndarray, tdollar: np.ndarray
+    ) -> Tuple[jax.Array, jax.Array]:
+        """→ (packed bitmap sharded [B, cap//32], per-topic counts [B])."""
+        dev = self._refresh()
+        sh = lambda arr, spec: jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return self._step(
+            *dev,
+            sh(ttok, P("dp", None)),
+            sh(tlen, P("dp")),
+            sh(tdollar, P("dp")),
+        )

@@ -1,0 +1,15 @@
+"""Subscription routing: the `Router` seam of the framework.
+
+Mirrors the reference's swappable `Router` trait
+(`/root/reference/rmqtt/src/router.rs:65-112`) behind which the cluster
+plugins and the TPU backend plug in. Two implementations:
+
+- ``DefaultRouter``: CPU topic-trie router — the faithful baseline
+  (`/root/reference/rmqtt/src/router.rs:121-265`).
+- ``XlaRouter``: the north star — filter table in TPU HBM, batched
+  `matches()` through `rmqtt_tpu.ops`.
+"""
+
+from rmqtt_tpu.router.base import Id, Router, SubRelation, SubscriptionOptions
+from rmqtt_tpu.router.default import DefaultRouter
+from rmqtt_tpu.router.xla import XlaRouter

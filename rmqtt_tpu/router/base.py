@@ -1,0 +1,119 @@
+"""Router interface and routing data types.
+
+Mirrors the reference's `Router` trait and DTOs:
+`/root/reference/rmqtt/src/router.rs:65-112` (add/remove/matches/gets/
+query_subscriptions/topics/routes), `/root/reference/rmqtt/src/types.rs:476-486`
+(``AllRelationsMap``, ``SubRelation``, ``SubRelationsMap``) and the
+``SubscriptionOptions`` carried on every subscription (types.rs).
+
+The shared-subscription *choice point* lives in `matches()` exactly as in the
+reference (`router.rs:236-255`): matched relations in a ``$share`` group are
+collapsed to one subscriber by the pluggable strategy, with liveness supplied
+by the session layer.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+NodeId = int
+ClientId = str
+
+
+@dataclass(frozen=True)
+class Id:
+    """Session identity: owning node + client id (reference types.rs Id)."""
+
+    node_id: NodeId
+    client_id: ClientId
+
+
+@dataclass(frozen=True)
+class SubscriptionOptions:
+    """Per-subscription options (reference types.rs ``SubscriptionOptions``)."""
+
+    qos: int = 0
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+    subscription_ids: Tuple[int, ...] = ()
+    shared_group: Optional[str] = None
+
+    def merge_sub_id(self, sub_id: Optional[int]) -> "SubscriptionOptions":
+        if sub_id is None:
+            return self
+        return replace(self, subscription_ids=(sub_id,))
+
+
+@dataclass(frozen=True)
+class SubRelation:
+    """One matched (filter → subscriber) edge (reference types.rs:485)."""
+
+    topic_filter: str
+    id: Id
+    opts: SubscriptionOptions
+
+
+# node_id → relations to deliver there (reference types.rs:486 SubRelationsMap)
+SubRelationsMap = Dict[NodeId, List[SubRelation]]
+
+# choice(group, candidates[(id, opts, is_online)]) -> index or None
+# (reference rmqtt/src/subscribe.rs:71-96 SharedSubscription::choice)
+SharedChoiceFn = Callable[[str, str, List[Tuple[Id, SubscriptionOptions, bool]]], Optional[int]]
+
+
+def round_robin_choice_factory() -> SharedChoiceFn:
+    """Default shared-sub strategy: round-robin over online candidates
+    (reference rmqtt/src/subscribe.rs:98-107 default impl)."""
+    counters: Dict[str, int] = {}
+
+    def choice(group: str, topic_filter: str, candidates):
+        online = [i for i, (_, _, is_on) in enumerate(candidates) if is_on]
+        pool = online or list(range(len(candidates)))
+        if not pool:
+            return None
+        key = f"{group}\x00{topic_filter}"
+        n = counters.get(key, 0)
+        counters[key] = n + 1
+        return pool[n % len(pool)]
+
+    return choice
+
+
+class Router(abc.ABC):
+    """The swappable routing seam (reference router.rs:65-112)."""
+
+    @abc.abstractmethod
+    def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
+        """Register a subscription (filter already stripped of ``$share``)."""
+
+    @abc.abstractmethod
+    def remove(self, topic_filter: str, id: Id) -> bool:
+        """Remove a subscription; True if it existed."""
+
+    @abc.abstractmethod
+    def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
+        """All deliverable relations for one publish topic."""
+
+    def matches_batch(self, items: Sequence[Tuple[Optional[Id], str]]) -> List[SubRelationsMap]:
+        """Batched `matches` — the TPU path overrides this with one kernel call."""
+        return [self.matches(fid, topic) for fid, topic in items]
+
+    # --- admin / introspection surface (router.rs gets/query/topics) ---
+    @abc.abstractmethod
+    def gets(self, limit: int) -> List[dict]:
+        """List (topic_filter, client) routes up to limit."""
+
+    @abc.abstractmethod
+    def topics_count(self) -> int:
+        """Number of distinct stored topic filters."""
+
+    @abc.abstractmethod
+    def routes_count(self) -> int:
+        """Number of stored (filter, client) subscription edges."""
+
+    @abc.abstractmethod
+    def is_match(self, topic: str) -> bool:
+        """Does any subscription match this topic?"""

@@ -1,0 +1,68 @@
+"""CPU trie-backed router — the faithful baseline implementation.
+
+Mirrors `DefaultRouter` (`/root/reference/rmqtt/src/router.rs:121-265`):
+a topic trie over filter shapes plus a relations map, per-publish trie DFS
+in `matches()`. This is the CPU oracle the TPU path is benchmarked against
+(BASELINE.md: the reference publishes no routing microbenchmark, so this
+implementation *is* the baseline).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from rmqtt_tpu.core.trie import TopicTree
+from rmqtt_tpu.router.base import (
+    ClientId,
+    Id,
+    Router,
+    SharedChoiceFn,
+    SubRelationsMap,
+    SubscriptionOptions,
+    round_robin_choice_factory,
+)
+from rmqtt_tpu.router.relations import RelationsMap, expand_matches
+
+
+class DefaultRouter(Router):
+    def __init__(
+        self,
+        shared_choice: Optional[SharedChoiceFn] = None,
+        is_online: Callable[[ClientId], bool] = lambda cid: True,
+    ) -> None:
+        self._trie: TopicTree[str] = TopicTree()
+        self._relations = RelationsMap()
+        self._shared_choice = shared_choice or round_robin_choice_factory()
+        self._is_online = is_online
+
+    def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
+        if self._relations.add(topic_filter, id, opts):
+            self._trie.insert(topic_filter, topic_filter)
+
+    def remove(self, topic_filter: str, id: Id) -> bool:
+        existed, empty = self._relations.remove(topic_filter, id)
+        if empty:
+            self._trie.remove(topic_filter, topic_filter)
+        return existed
+
+    def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
+        matched = [tf for _levels, vals in self._trie.matches(topic) for tf in vals]
+        return expand_matches(matched, self._relations, from_id, self._shared_choice, self._is_online)
+
+    def is_match(self, topic: str) -> bool:
+        return self._trie.is_match(topic)
+
+    def gets(self, limit: int) -> List[dict]:
+        out: List[dict] = []
+        for tf, rels in self._relations.items():
+            for cid in rels:
+                if len(out) >= limit:
+                    return out
+                out.append({"topic_filter": tf, "client_id": cid})
+        return out
+
+    def topics_count(self) -> int:
+        return len(self._relations)
+
+    def routes_count(self) -> int:
+        return self._relations.edge_count

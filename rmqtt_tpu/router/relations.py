@@ -1,0 +1,93 @@
+"""Relation bookkeeping shared by the CPU and TPU routers.
+
+The reference keeps the trie (filter shapes) separate from the relations map
+(filter → {client: (Id, opts)}), `/root/reference/rmqtt/src/router.rs:121-139`
+(``AllRelationsMap``, types.rs:476). Both router backends here reuse that
+split: the matcher (trie or TPU table) yields matched *filters*; this module
+expands filters to clients, applies v5 No-Local (router.rs:196-201), and
+collapses ``$share`` groups through the strategy (router.rs:236-255).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from rmqtt_tpu.router.base import (
+    ClientId,
+    Id,
+    SharedChoiceFn,
+    SubRelation,
+    SubRelationsMap,
+    SubscriptionOptions,
+    round_robin_choice_factory,
+)
+
+
+class RelationsMap:
+    """filter → {client_id: (Id, opts)} with counters."""
+
+    def __init__(self) -> None:
+        self._map: Dict[str, Dict[ClientId, Tuple[Id, SubscriptionOptions]]] = {}
+        self.edge_count = 0
+
+    def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> bool:
+        """Returns True if the filter is new (needs matcher insertion)."""
+        rels = self._map.get(topic_filter)
+        is_new = rels is None
+        if is_new:
+            rels = self._map[topic_filter] = {}
+        if id.client_id not in rels:
+            self.edge_count += 1
+        rels[id.client_id] = (id, opts)
+        return is_new
+
+    def remove(self, topic_filter: str, id: Id) -> Tuple[bool, bool]:
+        """Returns (existed, filter_now_empty)."""
+        rels = self._map.get(topic_filter)
+        if not rels or id.client_id not in rels:
+            return False, False
+        del rels[id.client_id]
+        self.edge_count -= 1
+        if not rels:
+            del self._map[topic_filter]
+            return True, True
+        return True, False
+
+    def get(self, topic_filter: str) -> Dict[ClientId, Tuple[Id, SubscriptionOptions]]:
+        return self._map.get(topic_filter, {})
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def items(self):
+        return self._map.items()
+
+
+def expand_matches(
+    matched_filters: List[str],
+    relations: RelationsMap,
+    from_id: Optional[Id],
+    shared_choice: SharedChoiceFn,
+    is_online: Callable[[ClientId], bool],
+) -> SubRelationsMap:
+    """Filters → SubRelationsMap with No-Local + shared-group collapse."""
+    out: SubRelationsMap = {}
+    # (group, filter) → candidates [(Id, opts, online)]
+    shared: Dict[Tuple[str, str], List[Tuple[Id, SubscriptionOptions, bool]]] = {}
+    for tf in matched_filters:
+        for cid, (sid, opts) in relations.get(tf).items():
+            if opts.no_local and from_id is not None and cid == from_id.client_id:
+                continue  # v5 No-Local (router.rs:196-201)
+            if opts.shared_group is not None:
+                shared.setdefault((opts.shared_group, tf), []).append(
+                    (sid, opts, is_online(cid))
+                )
+            else:
+                out.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
+    for (group, tf), candidates in shared.items():
+        idx = shared_choice(group, tf, candidates)
+        if idx is None:
+            continue
+        sid, opts, _ = candidates[idx]
+        out.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
+    return out

@@ -1,0 +1,93 @@
+"""The north-star router: subscription matching on TPU.
+
+Swaps the reference's trie DFS (`/root/reference/rmqtt/src/router.rs:174-265`)
+for the batched XLA matcher over the flattened filter table in device HBM
+(see `rmqtt_tpu.ops`). Publish ingress is micro-batched: `matches_batch()`
+encodes B topics and resolves all of them in one kernel launch; matched
+*filter ids* come back as packed bitmaps and are expanded host-side to
+clients via the relations map — the same kernel/host split the reference
+uses between trie and ``AllRelationsMap`` (router.rs:121-139), per
+SURVEY.md §7 "hard parts".
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from rmqtt_tpu.ops.encode import FilterTable
+from rmqtt_tpu.ops.match import TpuMatcher
+from rmqtt_tpu.router.base import (
+    ClientId,
+    Id,
+    Router,
+    SharedChoiceFn,
+    SubRelationsMap,
+    SubscriptionOptions,
+    round_robin_choice_factory,
+)
+from rmqtt_tpu.router.relations import RelationsMap, expand_matches
+
+
+class XlaRouter(Router):
+    def __init__(
+        self,
+        shared_choice: Optional[SharedChoiceFn] = None,
+        is_online: Callable[[ClientId], bool] = lambda cid: True,
+        table: Optional[FilterTable] = None,
+        device=None,
+    ) -> None:
+        self.table = table or FilterTable()
+        self.matcher = TpuMatcher(self.table, device=device)
+        self._relations = RelationsMap()
+        self._fid_to_filter: Dict[int, str] = {}
+        self._filter_to_fid: Dict[str, int] = {}
+        self._shared_choice = shared_choice or round_robin_choice_factory()
+        self._is_online = is_online
+
+    def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
+        if self._relations.add(topic_filter, id, opts):
+            fid = self.table.add(topic_filter)
+            self._fid_to_filter[fid] = topic_filter
+            self._filter_to_fid[topic_filter] = fid
+
+    def remove(self, topic_filter: str, id: Id) -> bool:
+        existed, empty = self._relations.remove(topic_filter, id)
+        if empty:
+            fid = self._filter_to_fid.pop(topic_filter)
+            del self._fid_to_filter[fid]
+            self.table.remove(fid)
+        return existed
+
+    def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
+        return self.matches_batch([(from_id, topic)])[0]
+
+    def matches_batch(self, items: Sequence[Tuple[Optional[Id], str]]) -> List[SubRelationsMap]:
+        topics = [topic for _, topic in items]
+        fid_rows = self.matcher.match(topics)
+        out: List[SubRelationsMap] = []
+        f2f = self._fid_to_filter
+        for (from_id, _topic), fids in zip(items, fid_rows):
+            matched = [f2f[fid] for fid in fids.tolist()]
+            out.append(
+                expand_matches(matched, self._relations, from_id, self._shared_choice, self._is_online)
+            )
+        return out
+
+    def is_match(self, topic: str) -> bool:
+        (fids,) = self.matcher.match([topic])
+        return fids.size > 0
+
+    def gets(self, limit: int) -> List[dict]:
+        out: List[dict] = []
+        for tf, rels in self._relations.items():
+            for cid in rels:
+                if len(out) >= limit:
+                    return out
+                out.append({"topic_filter": tf, "client_id": cid})
+        return out
+
+    def topics_count(self) -> int:
+        return len(self._relations)
+
+    def routes_count(self) -> int:
+        return self._relations.edge_count

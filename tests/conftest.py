@@ -1,13 +1,18 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any ``import jax`` (pytest imports conftest first). The real
-TPU chip is reserved for ``bench.py``; tests exercise sharding on virtual CPU
-devices per the build contract.
+The environment preloads jax (sitecustomize) with the TPU platform already
+selected, so mutating ``JAX_PLATFORMS`` here is too late — use
+``jax.config.update`` before the first backend initialisation instead. The
+real TPU chip is reserved for ``bench.py``; tests exercise sharding on
+virtual CPU devices per the build contract.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,141 @@
+"""Router-layer tests: DefaultRouter and XlaRouter must agree.
+
+Covers the `Router` seam semantics of the reference
+(`/root/reference/rmqtt/src/router.rs:174-265`): relation expansion,
+v5 No-Local, shared-subscription group collapse, counters, churn.
+"""
+
+import random
+
+import pytest
+
+from rmqtt_tpu.core.topic import parse_shared
+from rmqtt_tpu.router import DefaultRouter, Id, SubscriptionOptions, XlaRouter
+
+
+def both_routers(**kw):
+    return [DefaultRouter(**kw), XlaRouter(**kw)]
+
+
+def flat(relmap):
+    """SubRelationsMap → sorted [(node, filter, client)]."""
+    return sorted(
+        (node, r.topic_filter, r.id.client_id) for node, rels in relmap.items() for r in rels
+    )
+
+
+@pytest.mark.parametrize("router_cls", [DefaultRouter, XlaRouter])
+def test_basic_add_match_remove(router_cls):
+    r = router_cls()
+    a, b = Id(1, "alice"), Id(2, "bob")
+    r.add("sensors/+/temp", a, SubscriptionOptions(qos=1))
+    r.add("sensors/#", b, SubscriptionOptions(qos=0))
+    assert r.topics_count() == 2
+    assert r.routes_count() == 2
+
+    m = r.matches(None, "sensors/kitchen/temp")
+    assert flat(m) == [(1, "sensors/+/temp", "alice"), (2, "sensors/#", "bob")]
+    assert r.is_match("sensors/x")
+    assert not r.is_match("other")
+
+    assert r.remove("sensors/+/temp", a)
+    assert not r.remove("sensors/+/temp", a)
+    assert r.topics_count() == 1
+    m = r.matches(None, "sensors/kitchen/temp")
+    assert flat(m) == [(2, "sensors/#", "bob")]
+
+
+@pytest.mark.parametrize("router_cls", [DefaultRouter, XlaRouter])
+def test_no_local(router_cls):
+    r = router_cls()
+    pub = Id(1, "selfie")
+    r.add("t/x", pub, SubscriptionOptions(no_local=True))
+    r.add("t/x", Id(1, "other"), SubscriptionOptions(no_local=True))
+    assert flat(r.matches(pub, "t/x")) == [(1, "t/x", "other")]
+    # without from_id (e.g. bridge ingress) no_local does not apply
+    assert len(flat(r.matches(None, "t/x"))) == 2
+
+
+@pytest.mark.parametrize("router_cls", [DefaultRouter, XlaRouter])
+def test_shared_group_collapse_round_robin(router_cls):
+    r = router_cls()
+    group, tf = parse_shared("$share/g1/jobs/#")
+    assert group == "g1"
+    for i in range(3):
+        r.add(tf, Id(1, f"w{i}"), SubscriptionOptions(qos=1, shared_group=group))
+    r.add(tf, Id(1, "observer"), SubscriptionOptions(qos=1))
+
+    seen = []
+    for _ in range(6):
+        m = flat(r.matches(None, "jobs/a"))
+        workers = [c for _, _, c in m if c != "observer"]
+        assert len(workers) == 1  # exactly one group member chosen
+        assert ("observer" in [c for _, _, c in m])
+        seen.append(workers[0])
+    # round robin cycles through all members
+    assert set(seen) == {"w0", "w1", "w2"}
+
+
+@pytest.mark.parametrize("router_cls", [DefaultRouter, XlaRouter])
+def test_shared_group_prefers_online(router_cls):
+    online = {"w0": False, "w1": True, "w2": False}
+    r = router_cls(is_online=lambda cid: online.get(cid, True))
+    for i in range(3):
+        r.add("jobs/#", Id(1, f"w{i}"), SubscriptionOptions(shared_group="g"))
+    for _ in range(4):
+        m = flat(r.matches(None, "jobs/a"))
+        assert [c for _, _, c in m] == ["w1"]
+
+
+@pytest.mark.parametrize("router_cls", [DefaultRouter, XlaRouter])
+def test_multi_node_relations(router_cls):
+    r = router_cls()
+    r.add("t/#", Id(1, "n1c"), SubscriptionOptions())
+    r.add("t/#", Id(2, "n2c"), SubscriptionOptions())
+    r.add("t/+", Id(2, "n2d"), SubscriptionOptions())
+    m = r.matches(None, "t/k")
+    assert sorted(m.keys()) == [1, 2]
+    assert len(m[1]) == 1 and len(m[2]) == 2
+
+
+def test_routers_agree_randomized():
+    rng = random.Random(5)
+    d, x = DefaultRouter(), XlaRouter()
+    words = ["a", "b", "c", "", "+"]
+    subs = []
+    for i in range(400):
+        n = rng.randint(1, 5)
+        levels = [rng.choice(words) for _ in range(n)]
+        if rng.random() < 0.3:
+            levels[-1] = "#"
+        tf = "/".join(levels)
+        from rmqtt_tpu.core.topic import filter_valid
+
+        if not filter_valid(tf):
+            continue
+        sid = Id(rng.randint(1, 3), f"c{i % 60}")
+        opts = SubscriptionOptions(qos=rng.randint(0, 2), no_local=rng.random() < 0.2)
+        subs.append((tf, sid))
+        d.add(tf, sid, opts)
+        x.add(tf, sid, opts)
+    # random removals
+    for tf, sid in rng.sample(subs, len(subs) // 3):
+        assert d.remove(tf, sid) == x.remove(tf, sid)
+    assert d.topics_count() == x.topics_count()
+    assert d.routes_count() == x.routes_count()
+
+    for _ in range(120):
+        n = rng.randint(1, 6)
+        topic = "/".join(rng.choice(["a", "b", "c", "d", ""]) for _ in range(n))
+        from_id = Id(1, f"c{rng.randint(0, 70)}") if rng.random() < 0.5 else None
+        assert flat(d.matches(from_id, topic)) == flat(x.matches(from_id, topic)), topic
+
+
+def test_batched_matches_xla():
+    x = XlaRouter()
+    x.add("a/+", Id(1, "c1"), SubscriptionOptions())
+    x.add("b/#", Id(1, "c2"), SubscriptionOptions())
+    out = x.matches_batch([(None, "a/1"), (None, "b/1/2"), (None, "zzz")])
+    assert flat(out[0]) == [(1, "a/+", "c1")]
+    assert flat(out[1]) == [(1, "b/#", "c2")]
+    assert flat(out[2]) == []
