@@ -284,7 +284,6 @@ def main():
 
     if want(2):
         filters = gen_single_plus(rng, 100_000)
-        topics = gen_topics_uniform(rng, 20_000, depth=4)
         # depth 3-5 filters over l{d}n{...} names: generate matching-shape topics
         topics = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5))) for _ in range(20_000)]
         results["cfg2_plus_100k"] = run_config("cfg2_plus_100k", filters, topics, 2048, 512)

@@ -1,0 +1,3 @@
+from rmqtt_tpu.broker.server import main
+
+main()

@@ -52,17 +52,35 @@ class MqttCodec:
         self.version = version
         self.max_inbound_size = max_inbound_size
         self._buf = bytearray()
+        # set when a frame fails to decode: earlier valid packets from the
+        # same feed() are still returned; callers must check it after
+        # processing them (and then close the connection)
+        self.pending_error: Optional[ProtocolError] = None
 
     # ------------------------------------------------------------- decode
     def feed(self, data: bytes) -> List[Packet]:
+        if self.pending_error is not None:
+            raise self.pending_error
         self._buf += data
         out: List[Packet] = []
         while True:
-            frame = self._next_frame()
+            try:
+                frame = self._next_frame()
+            except ProtocolError as e:
+                self.pending_error = e
+                if out:
+                    return out  # deliver what decoded before the bad frame
+                raise
             if frame is None:
                 return out
             first, body = frame
-            out.append(self._decode(first, body))
+            try:
+                out.append(self._decode(first, body))
+            except ProtocolError as e:
+                self.pending_error = e
+                if out:
+                    return out
+                raise
 
     def _next_frame(self) -> Optional[Tuple[int, bytes]]:
         buf = self._buf
@@ -312,6 +330,9 @@ class MqttCodec:
         raise ProtocolError(f"cannot encode {type(p).__name__}")
 
     def _encode_connect(self, p: Connect) -> bytes:
+        # mirror _decode_connect: the negotiated version governs all
+        # subsequent packets on this codec (client-side use)
+        self.version = p.protocol
         v5 = p.protocol == pk.V5
         if p.protocol == pk.V31:
             head = encode_binary(b"MQIsdp") + bytes([3])

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 from rmqtt_tpu.broker.codec.primitives import (
+    ProtocolViolation,
     Reader,
     encode_binary,
     encode_utf8,
@@ -94,7 +95,7 @@ def encode_properties(props: Dict[int, object]) -> bytes:
     for pid, value in props.items():
         ptype = _TYPES.get(pid)
         if ptype is None:
-            raise ValueError(f"unknown property id {pid}")
+            raise ProtocolViolation(f"unknown property id {pid}")
         values = value if pid in _REPEATABLE and isinstance(value, list) else [value]
         for v in values:
             body += encode_varint(pid)
@@ -124,7 +125,7 @@ def decode_properties(r: Reader) -> Dict[int, object]:
         pid = r.varint()
         ptype = _TYPES.get(pid)
         if ptype is None:
-            raise ValueError(f"unknown property id {pid}")
+            raise ProtocolViolation(f"unknown property id {pid}")
         if ptype == _U8:
             v: object = r.u8()
         elif ptype == _U16:
@@ -144,8 +145,8 @@ def decode_properties(r: Reader) -> Dict[int, object]:
             props[pid].append(v)  # type: ignore[union-attr]
         else:
             if pid in props:
-                raise ValueError(f"duplicate property id {pid}")
+                raise ProtocolViolation(f"duplicate property id {pid}")
             props[pid] = v
     if r.pos != end:
-        raise ValueError("property length mismatch")
+        raise ProtocolViolation("property length mismatch")
     return props

@@ -1,0 +1,102 @@
+"""Server context: the shared runtime bundle.
+
+Mirrors the reference ``ServerContext`` (`/root/reference/rmqtt/src/context.rs:290-341`):
+one object carrying the swappable subsystems (router, session registry,
+retain store, delayed sender, hook registry, ACL, fitter, metrics) that every
+connection handler receives — the extension-manager seam
+(`rmqtt/src/extend.rs:64-113`) where cluster/TPU implementations swap in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from rmqtt_tpu.broker.acl import AclEngine
+from rmqtt_tpu.broker.delayed import DelayedSender
+from rmqtt_tpu.broker.fitter import Fitter, FitterConfig
+from rmqtt_tpu.broker.hooks import HookRegistry
+from rmqtt_tpu.broker.metrics import Metrics, Stats
+from rmqtt_tpu.broker.retain import RetainStore
+from rmqtt_tpu.broker.routing import RoutingService
+from rmqtt_tpu.router.base import Router
+
+
+@dataclass
+class BrokerConfig:
+    host: str = "127.0.0.1"
+    port: int = 1883
+    node_id: int = 1
+    router: str = "trie"  # "trie" (DefaultRouter) | "xla" (TPU)
+    allow_anonymous: bool = True
+    allow_zero_keepalive: bool = True
+    max_connections: int = 1_000_000
+    max_handshake_delay: float = 10.0
+    max_packet_size: int = 1024 * 1024
+    max_subscriptions: int = 0  # 0 = unlimited
+    max_topic_levels: int = 0
+    max_qos: int = 2
+    retain_enable: bool = True
+    retain_max: int = 1_000_000
+    delayed_publish_max: int = 100_000
+    shared_subscription: bool = True
+    batch_max: int = 1024
+    batch_linger_ms: float = 1.0
+    fitter: FitterConfig = field(default_factory=FitterConfig)
+
+
+class ServerContext:
+    def __init__(
+        self,
+        cfg: Optional[BrokerConfig] = None,
+        router: Optional[Router] = None,
+        acl: Optional[AclEngine] = None,
+    ) -> None:
+        from rmqtt_tpu.broker.shared import SessionRegistry
+        from rmqtt_tpu.router.default import DefaultRouter
+        from rmqtt_tpu.router.xla import XlaRouter
+
+        self.cfg = cfg or BrokerConfig()
+        self.hooks = HookRegistry()
+        self.metrics = Metrics()
+        if router is None:
+            online = lambda cid: (
+                self.registry.get(cid) is not None and self.registry.get(cid).connected
+            )
+            router = (
+                XlaRouter(is_online=online)
+                if self.cfg.router == "xla"
+                else DefaultRouter(is_online=online)
+            )
+        self.router = router
+        self.routing = RoutingService(
+            router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
+        )
+        self.retain = RetainStore(enable=self.cfg.retain_enable, max_retained=self.cfg.retain_max)
+        self.registry = SessionRegistry(self)
+        self.delayed = DelayedSender(self.registry.forwards, max_pending=self.cfg.delayed_publish_max)
+        self.acl = acl or AclEngine()
+        self.fitter = Fitter(self.cfg.fitter)
+        self.node_id = self.cfg.node_id
+
+    def start(self) -> None:
+        self.routing.start()
+        self.delayed.start()
+
+    async def stop(self) -> None:
+        await self.routing.stop()
+        await self.delayed.stop()
+
+    def stats(self) -> Stats:
+        s = Stats()
+        s.connections = self.registry.connected_count()
+        s.sessions = self.registry.session_count()
+        s.subscriptions = self.router.routes_count()
+        s.retaineds = self.retain.count()
+        s.delayed_publishs = len(self.delayed)
+        s.topics = self.router.topics_count()
+        s.routes = self.router.routes_count()
+        for sess in self.registry.sessions():
+            s.message_queues += len(sess.deliver_queue)
+            s.out_inflights += len(sess.out_inflight)
+        return s

@@ -79,6 +79,9 @@ class OutInflight:
             e.status = MomentStatus.UNCOMPLETE
             e.sent_at = time.monotonic()
             e.retries = 0
+            # keep the dict ordered by sent_at so next_retry_in() can look at
+            # the head only
+            self._entries.move_to_end(packet_id)
         return e
 
     def next_retry_in(self) -> Optional[float]:
@@ -102,6 +105,8 @@ class OutInflight:
         if e.retries > self.max_retries:
             self._entries.pop(e.packet_id, None)
             return False
+        if e.packet_id in self._entries:
+            self._entries.move_to_end(e.packet_id)  # keep sent_at ordering
         return True
 
     def drain(self) -> Iterator[OutEntry]:
@@ -119,7 +124,9 @@ class InInflight:
         self._ids: set[int] = set()
 
     def add(self, packet_id: int) -> bool:
-        """False if duplicate or window full."""
+        """False if the window is full. Callers must check ``packet_id in
+        self`` first for the duplicate case (which needs a PUBREC reply,
+        while a full window needs RC_RECEIVE_MAX_EXCEEDED)."""
         if packet_id in self._ids or len(self._ids) >= self.max_size:
             return False
         self._ids.add(packet_id)

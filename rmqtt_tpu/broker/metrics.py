@@ -1,0 +1,48 @@
+"""Broker metrics & stats.
+
+Mirrors the reference's counter surface (`/root/reference/rmqtt/src/metrics.rs`
+50+ atomic counters via #[derive(Metrics)], and `stats.rs` gauges). Python
+ints under the GIL are atomic enough for the host side; the TPU kernel path
+reports its own batch counters.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    """Named monotonic counters (metrics.rs:68-135 naming scheme)."""
+
+    def __init__(self) -> None:
+        self._c: Dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self._c[name] += n
+
+    def get(self, name: str) -> int:
+        return self._c.get(name, 0)
+
+    def to_json(self) -> Dict[str, int]:
+        return dict(sorted(self._c.items()))
+
+
+class Stats:
+    """Gauge snapshot (stats.rs:73-132): filled in by ServerContext.stats()."""
+
+    def __init__(self) -> None:
+        self.connections = 0
+        self.sessions = 0
+        self.subscriptions = 0
+        self.subscriptions_shared = 0
+        self.retaineds = 0
+        self.delayed_publishs = 0
+        self.in_inflights = 0
+        self.out_inflights = 0
+        self.message_queues = 0
+        self.topics = 0
+        self.routes = 0
+
+    def to_json(self) -> Dict[str, int]:
+        return dict(vars(self))

@@ -1,0 +1,79 @@
+"""Bounded deliver queue with drop policies.
+
+Mirrors `/root/reference/rmqtt/src/queue.rs`: the per-session message queue
+between fan-out and the socket writer, bounded, with a drop ``Policy``
+(:65-75) — ``DROP_CURRENT`` discards the incoming message (used for QoS0),
+``DROP_EARLY`` discards the oldest queued one. An optional token-bucket rate
+limit mirrors the ``Limiter``-wrapped receiver (:201-238).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from collections import deque
+from typing import Deque, Generic, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class Policy(enum.Enum):
+    DROP_CURRENT = "current"  # drop the new message (queue.rs Policy::Current)
+    DROP_EARLY = "early"  # drop the oldest queued message (Policy::Early)
+
+
+class DeliverQueue(Generic[T]):
+    def __init__(self, maxlen: int = 1000, rate_limit: Optional[float] = None) -> None:
+        self.maxlen = maxlen
+        self._q: Deque[T] = deque()
+        self._event = asyncio.Event()
+        self._rate_limit = rate_limit
+        self._allowance = rate_limit or 0.0
+        self._last = time.monotonic()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, item: T, policy: Policy = Policy.DROP_EARLY) -> Optional[T]:
+        """Enqueue; returns the dropped item if the queue was full."""
+        dropped: Optional[T] = None
+        if len(self._q) >= self.maxlen:
+            if policy is Policy.DROP_CURRENT:
+                return item
+            dropped = self._q.popleft()
+        self._q.append(item)
+        self._event.set()
+        return dropped
+
+    def pop(self) -> Optional[T]:
+        if not self._q:
+            self._event.clear()
+            return None
+        return self._q.popleft()
+
+    async def wait_nonempty(self) -> None:
+        if self._q:
+            return
+        self._event.clear()
+        await self._event.wait()
+
+    async def throttle(self) -> None:
+        """Token-bucket pacing of the consumer (queue.rs Limiter)."""
+        if not self._rate_limit:
+            return
+        nw = time.monotonic()
+        self._allowance = min(
+            self._rate_limit, self._allowance + (nw - self._last) * self._rate_limit
+        )
+        self._last = nw
+        if self._allowance < 1.0:
+            await asyncio.sleep((1.0 - self._allowance) / self._rate_limit)
+            self._allowance = 0.0
+        else:
+            self._allowance -= 1.0
+
+    def drain(self) -> Deque[T]:
+        q, self._q = self._q, deque()
+        self._event.clear()
+        return q

@@ -1,0 +1,125 @@
+"""Retained-message store.
+
+Mirrors the reference's `RetainStorage` trait + in-memory default
+(`/root/reference/rmqtt/src/retain.rs:100-213`): set (empty payload clears,
+MQTT-3.3.1-10/11), wildcard lookup on SUBSCRIBE, per-message expiry, count
+and max limits. Backed by the CPU ``RetainTree``; when the store grows past
+``tpu_threshold`` the wildcard lookup switches to the TPU inverse-match
+kernel (`rmqtt_tpu.ops.retained`) over a mirrored row table — the same
+automaton the router uses, per the north star.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from rmqtt_tpu.core.topic import filter_valid
+from rmqtt_tpu.core.trie import RetainTree
+from rmqtt_tpu.broker.types import Message, now
+
+
+class RetainStore:
+    def __init__(
+        self,
+        enable: bool = True,
+        max_retained: int = 1_000_000,
+        max_payload: int = 1024 * 1024,
+        tpu: bool = False,
+        tpu_threshold: int = 50_000,
+    ) -> None:
+        self.enable = enable
+        self.max_retained = max_retained
+        self.max_payload = max_payload
+        self._tree: RetainTree[Message] = RetainTree()
+        self._tpu = tpu
+        self._tpu_threshold = tpu_threshold
+        self._table = None  # lazily-built ops.encode.FilterTable mirror
+        self._scanner = None
+        self._rowid_by_topic: Dict[str, int] = {}
+        self._msg_by_rowid: Dict[int, Tuple[str, Message]] = {}
+
+    def count(self) -> int:
+        return self._tree.count()
+
+    def set(self, topic: str, msg: Message) -> bool:
+        """Store/replace/clear; returns False if refused (limits/disabled)."""
+        if not self.enable:
+            return False
+        if not msg.payload:  # empty payload clears (MQTT-3.3.1-10)
+            self._tree.remove(topic)
+            self._drop_row(topic)
+            return True
+        if len(msg.payload) > self.max_payload:
+            return False
+        if self._tree.get(topic) is None and self._tree.count() >= self.max_retained:
+            return False
+        self._tree.insert(topic, msg)
+        if self._tpu:
+            self._set_row(topic, msg)
+        return True
+
+    def get(self, topic: str) -> Optional[Message]:
+        msg = self._tree.get(topic)
+        if msg is not None and msg.is_expired():
+            self._tree.remove(topic)
+            self._drop_row(topic)
+            return None
+        return msg
+
+    def matches(self, topic_filter: str) -> List[Tuple[str, Message]]:
+        """All retained (topic, message) matching a new subscription's filter."""
+        if not self.enable or not filter_valid(topic_filter):
+            return []
+        if self._tpu and self.count() >= self._tpu_threshold:
+            out = self._matches_tpu(topic_filter)
+        else:
+            out = [("/".join(levels), msg) for levels, msg in self._tree.matches(topic_filter)]
+        fresh = []
+        for topic, msg in out:
+            if msg.is_expired():
+                self._tree.remove(topic)
+                self._drop_row(topic)
+            else:
+                fresh.append((topic, msg))
+        return fresh
+
+    def expire_sweep(self) -> int:
+        """Periodic expiry cleanup (retainer plugin's cleanup loop)."""
+        expired = ["/".join(levels) for levels, msg in self._tree.items() if msg.is_expired()]
+        for t in expired:
+            self._tree.remove(t)
+            self._drop_row(t)
+        return len(expired)
+
+    # ---- TPU mirror -------------------------------------------------------
+    def _ensure_tpu(self):
+        if self._scanner is None:
+            from rmqtt_tpu.ops.encode import FilterTable
+            from rmqtt_tpu.ops.retained import RetainedScanner
+
+            self._table = FilterTable()
+            self._scanner = RetainedScanner(self._table)
+            # backfill current tree contents (incl. $-topics)
+            for levels, msg in self._tree.items():
+                self._set_row("/".join(levels), msg, backfill_only=True)
+
+    def _set_row(self, topic: str, msg: Message, backfill_only: bool = False) -> None:
+        if self._scanner is None and not backfill_only:
+            return  # rows are built lazily on first TPU lookup
+        rid = self._rowid_by_topic.get(topic)
+        if rid is None:
+            rid = self._table.add(topic)
+            self._rowid_by_topic[topic] = rid
+        self._msg_by_rowid[rid] = (topic, msg)
+
+    def _drop_row(self, topic: str) -> None:
+        rid = self._rowid_by_topic.pop(topic, None)
+        if rid is not None:
+            self._msg_by_rowid.pop(rid, None)
+            if self._table is not None:
+                self._table.remove(rid)
+
+    def _matches_tpu(self, topic_filter: str) -> List[Tuple[str, Message]]:
+        self._ensure_tpu()
+        (row,) = self._scanner.scan([topic_filter])
+        return [self._msg_by_rowid[rid] for rid in row.tolist() if rid in self._msg_by_rowid]

@@ -1,0 +1,82 @@
+"""Micro-batched routing service: the publish-ingress → kernel seam.
+
+The reference resolves `Router::matches()` inline per publish
+(`/root/reference/rmqtt/src/shared.rs:771-778`). The TPU path instead runs a
+bounded ingress queue + batcher (SURVEY.md §2.4 item 2's back-pressure system
+re-purposed): concurrent publishes park a future on the queue; the drain task
+collects up to ``max_batch`` (or until ``linger_ms`` passes) and resolves
+them with ONE ``Router.matches_batch`` call. With ``DefaultRouter`` the batch
+degrades to a loop — the seam is identical, only the router swaps, exactly
+like the reference's extension manager (`rmqtt/src/extend.rs:64-113`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Tuple
+
+from rmqtt_tpu.router.base import Id, Router, SubRelationsMap
+
+
+class RoutingService:
+    def __init__(
+        self,
+        router: Router,
+        max_batch: int = 1024,
+        linger_ms: float = 1.0,
+        max_queue: int = 100_000,
+    ) -> None:
+        self.router = router
+        self.max_batch = max_batch
+        self.linger = linger_ms / 1000.0
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
+        fut = asyncio.get_running_loop().create_future()
+        await self._q.put((from_id, topic, fut))
+        return await fut
+
+    async def _collect(self) -> List[Tuple[Optional[Id], str, asyncio.Future]]:
+        batch = [await self._q.get()]
+        deadline = asyncio.get_running_loop().time() + self.linger
+        while len(batch) < self.max_batch:
+            timeout = deadline - asyncio.get_running_loop().time()
+            if timeout <= 0:
+                break
+            try:
+                batch.append(await asyncio.wait_for(self._q.get(), timeout))
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            batch = await self._collect()
+            items = [(fid, topic) for fid, topic, _ in batch]
+            try:
+                # matches_batch blocks on device compute; keep the event loop
+                # free (numpy/jax release the GIL for the heavy parts)
+                results = await loop.run_in_executor(None, self.router.matches_batch, items)
+            except Exception as e:  # resolve all waiters with the error
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_, _, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)

@@ -1,0 +1,214 @@
+"""The broker server: listeners + CONNECT handshake.
+
+Mirrors `/root/reference/rmqtt/src/server.rs` (accept loop, task per
+connection) and the v3/v5 handshake front-ends (`v3.rs:63-183`,
+`v5.rs:79-410`): busy check, CONNECT receive with timeout, hooks
+(client_connect → client_authenticate → client_connack → client_connected),
+session-takeover kick, fitter negotiation, CONNACK with v5 properties, then
+hand-off to the session run loop.
+
+Run standalone:  python -m rmqtt_tpu.broker --port 1883 [--router xla]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Optional
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk, props as P
+from rmqtt_tpu.broker.codec.primitives import ProtocolViolation
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.session import SessionState
+from rmqtt_tpu.broker.types import (
+    ConnectInfo,
+    RC_BAD_USERNAME_PASSWORD,
+    RC_NOT_AUTHORIZED,
+    RC_SUCCESS,
+    RC_UNSUPPORTED_PROTOCOL_VERSION,
+    V3_ACCEPTED,
+    V3_BAD_USERNAME_PASSWORD,
+    V3_NOT_AUTHORIZED,
+)
+from rmqtt_tpu.router.base import Id
+
+log = logging.getLogger("rmqtt_tpu.broker")
+
+
+class MqttBroker:
+    def __init__(self, ctx: Optional[ServerContext] = None, **cfg_kwargs) -> None:
+        self.ctx = ctx or ServerContext(BrokerConfig(**cfg_kwargs))
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        await self.ctx.hooks.fire(HookType.BEFORE_STARTUP)
+        self.ctx.start()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.ctx.cfg.host, self.ctx.cfg.port
+        )
+        log.info("listening on %s:%s", self.ctx.cfg.host, self.port)
+
+    async def stop(self) -> None:
+        # close sessions BEFORE wait_closed(): in py3.12 Server.wait_closed
+        # waits for all connection handlers, which only return once their
+        # session loops end
+        for session in self.ctx.registry.sessions():
+            if session.state is not None:
+                await session.state.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.ctx.stop()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    # ---------------------------------------------------------- per-conn
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        ctx = self.ctx
+        peer = writer.get_extra_info("peername")
+        codec = MqttCodec(max_inbound_size=ctx.cfg.max_packet_size)
+        ctx.metrics.inc("connections.accepted")
+        try:
+            connect = await asyncio.wait_for(
+                self._read_connect(reader, codec), timeout=ctx.cfg.max_handshake_delay
+            )
+        except (asyncio.TimeoutError, ProtocolViolation, ConnectionError):
+            ctx.metrics.inc("handshake.failures")
+            writer.close()
+            return
+        if connect is None:
+            writer.close()
+            return
+        await self._handshake(connect, reader, writer, codec, peer)
+
+    async def _read_connect(self, reader, codec) -> Optional[pk.Connect]:
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                return None
+            packets = codec.feed(data)
+            if packets:
+                p = packets[0]
+                if not isinstance(p, pk.Connect):
+                    return None
+                return p
+
+    async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer) -> None:
+        """v5.rs `_handshake` :191-410 (v3 mirror)."""
+        ctx = self.ctx
+        v5 = connect.protocol == pk.V5
+        assigned_id = None
+        if not connect.client_id:
+            if not v5 and not connect.clean_start:
+                await self._refuse(writer, codec, v5, 0x85, 2)
+                return
+            assigned_id = uuid.uuid4().hex
+            connect.client_id = assigned_id
+        id = Id(ctx.node_id, connect.client_id)
+        ci = ConnectInfo(
+            id=id,
+            protocol=connect.protocol,
+            keepalive=connect.keepalive,
+            clean_start=connect.clean_start,
+            username=connect.username,
+            password=connect.password,
+            properties=connect.properties,
+            remote_addr=peer,
+            will=connect.will,
+        )
+        await ctx.hooks.fire(HookType.CLIENT_CONNECT, ci, None, None)
+        # authenticate (client_authenticate hook; default allows anonymous
+        # per config — auth plugins override via higher-priority handlers)
+        default_auth = ctx.cfg.allow_anonymous or ci.username is not None
+        allowed = await ctx.hooks.fire(HookType.CLIENT_AUTHENTICATE, ci, None, initial=default_auth)
+        if not allowed:
+            ctx.metrics.inc("auth.failures")
+            await self._refuse(
+                writer, codec, v5, RC_NOT_AUTHORIZED, V3_NOT_AUTHORIZED
+            )
+            return
+        if connect.keepalive == 0 and not ctx.cfg.allow_zero_keepalive:
+            await self._refuse(writer, codec, v5, 0x8D, 2)
+            return
+        limits = ctx.fitter.fit(ci)
+        session, session_present = await ctx.registry.take_or_create(
+            ctx, id, ci, limits, connect.clean_start
+        )
+        # CONNACK (v5.rs:393-409)
+        ack_props = {}
+        if v5:
+            if assigned_id:
+                ack_props[P.ASSIGNED_CLIENT_IDENTIFIER] = assigned_id
+            if limits.server_keepalive:
+                ack_props[P.SERVER_KEEP_ALIVE] = limits.keepalive
+            ack_props[P.TOPIC_ALIAS_MAXIMUM] = limits.max_topic_aliases_in
+            ack_props[P.RECEIVE_MAXIMUM] = limits.max_inflight
+            ack_props[P.SESSION_EXPIRY_INTERVAL] = int(limits.session_expiry)
+            ack_props[P.RETAIN_AVAILABLE] = 1 if ctx.cfg.retain_enable else 0
+            ack_props[P.SHARED_SUBSCRIPTION_AVAILABLE] = (
+                1 if ctx.cfg.shared_subscription else 0
+            )
+            ack_props[P.MAXIMUM_QOS] = ctx.cfg.max_qos
+            ack_props[P.MAXIMUM_PACKET_SIZE] = ctx.cfg.max_packet_size
+        reason = await ctx.hooks.fire(
+            HookType.CLIENT_CONNACK, ci, session_present, initial=RC_SUCCESS
+        )
+        connack = pk.Connack(
+            session_present=session_present and reason == RC_SUCCESS,
+            reason_code=reason if v5 else (V3_ACCEPTED if reason == 0 else reason),
+            properties=ack_props,
+        )
+        writer.write(codec.encode(connack))
+        await writer.drain()
+        if reason != RC_SUCCESS:
+            writer.close()
+            return
+        state = SessionState(ctx, session, reader, writer, codec)
+        session.state = state
+        session.connected = True
+        ctx.metrics.inc("connections.established")
+        await ctx.hooks.fire(HookType.CLIENT_CONNECTED, ci, None, None)
+        try:
+            await state.run()
+        finally:
+            ctx.metrics.inc("connections.closed")
+
+    async def _refuse(self, writer, codec, v5: bool, rc5: int, rc3: int) -> None:
+        try:
+            writer.write(codec.encode(pk.Connack(False, rc5 if v5 else rc3)))
+            await writer.drain()
+        except Exception:
+            pass
+        writer.close()
+
+
+async def _amain(args) -> None:
+    cfg = BrokerConfig(host=args.host, port=args.port, router=args.router)
+    broker = MqttBroker(ServerContext(cfg))
+    await broker.serve_forever()
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="rmqtt_tpu broker")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("--router", choices=["trie", "xla"], default="trie")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

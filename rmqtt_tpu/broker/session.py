@@ -1,0 +1,608 @@
+"""Per-connection session state machine.
+
+Mirrors `/root/reference/rmqtt/src/session.rs`: the online loop (run_loop
+:308-402 — keepalive timer, inflight-retry timer, credit-gated deliver queue,
+control messages, socket), publish ingress (:908-1064 — QoS0/1/2 with
+in-flight QoS2 dedup, topic-alias resolve, ``$delayed`` parse, hooks, ACL,
+retain), the subscribe path (:1276-1371), offline behavior (session expiry +
+will-delay timers, :405-494), and takeover transfer (:1374-1427).
+
+The host/TPU split: nothing here touches the device — publishes are handed
+to ``SessionRegistry.forwards`` which parks on the micro-batched routing
+service (`broker/routing.py`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk, props as P
+from rmqtt_tpu.broker.codec.primitives import ProtocolViolation
+from rmqtt_tpu.broker.delayed import parse_delayed
+from rmqtt_tpu.broker.fitter import Limits
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.inflight import InInflight, MomentStatus, OutEntry, OutInflight
+from rmqtt_tpu.broker.queue import DeliverQueue, Policy
+from rmqtt_tpu.broker.types import (
+    ConnectInfo,
+    Message,
+    RC_NOT_AUTHORIZED,
+    RC_NO_MATCHING_SUBSCRIBERS,
+    RC_PACKET_ID_NOT_FOUND,
+    RC_SUCCESS,
+    RC_TOPIC_ALIAS_INVALID,
+    RC_TOPIC_FILTER_INVALID,
+    RC_TOPIC_NAME_INVALID,
+    RC_UNSPECIFIED_ERROR,
+    now,
+)
+from rmqtt_tpu.core.topic import (
+    InvalidSharedFilter,
+    filter_valid,
+    parse_shared,
+    split_levels,
+    topic_valid,
+)
+from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+
+@dataclass
+class DeliverItem:
+    """One queued outbound publish (post-fanout, pre-socket)."""
+
+    msg: Message
+    qos: int  # effective = min(sub qos, msg qos)
+    retain: bool  # retain-as-published / retained-replay flag
+    topic_filter: str
+    sub_ids: Tuple[int, ...] = ()
+    dup: bool = False
+
+
+class Session:
+    """Durable session state; survives reconnects when expiry > 0."""
+
+    def __init__(
+        self,
+        ctx,
+        id: Id,
+        connect_info: ConnectInfo,
+        limits: Limits,
+        clean_start: bool,
+    ) -> None:
+        self.ctx = ctx
+        self.id = id
+        self.client_id = id.client_id
+        self.connect_info = connect_info
+        self.limits = limits
+        self.clean_start = clean_start
+        self.created_at = now()
+        # original filter string (incl. $share prefix) → options
+        self.subscriptions: Dict[str, SubscriptionOptions] = {}
+        self.deliver_queue: DeliverQueue[DeliverItem] = DeliverQueue(limits.max_mqueue)
+        self.out_inflight = OutInflight(max_inflight=limits.max_inflight)
+        self.in_qos2 = InInflight()
+        self.connected = False
+        self.state: Optional["SessionState"] = None
+        self.will: Optional[pk.Will] = connect_info_will(connect_info)
+        self._will_task: Optional[asyncio.Task] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+
+    # ---------------------------------------------------------------- fanout
+    def enqueue(self, item: DeliverItem) -> None:
+        """Push into the deliver queue (fan-out target, shared.rs:876-963)."""
+        if not self.connected and self.limits.session_expiry <= 0:
+            self.ctx.metrics.inc("messages.dropped")
+            return
+        policy = Policy.DROP_CURRENT if item.qos == 0 and self.connected else Policy.DROP_EARLY
+        dropped = self.deliver_queue.push(item, policy)
+        if dropped is not None:
+            self.ctx.metrics.inc("messages.dropped")
+            asyncio.get_running_loop().create_task(
+                self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, self.id, dropped.msg, "queue-full")
+            )
+        if not self.connected:
+            asyncio.get_running_loop().create_task(
+                self.ctx.hooks.fire(HookType.OFFLINE_MESSAGE, self.id, item.msg, None)
+            )
+
+    # --------------------------------------------------------------- offline
+    def on_disconnect(self, clean: bool, kicked: bool = False) -> None:
+        """Socket gone: schedule will + expiry (session.rs:405-494)."""
+        self.connected = False
+        self.state = None
+        if self.will is not None and not clean and not kicked:
+            delay = float(self.will.properties.get(P.WILL_DELAY_INTERVAL, 0))
+            delay = min(delay, self.limits.session_expiry) if self.limits.session_expiry > 0 else 0.0
+            self._will_task = asyncio.get_running_loop().create_task(self._fire_will(delay))
+        if self.limits.session_expiry > 0 and not (kicked and self.clean_start):
+            self._expiry_task = asyncio.get_running_loop().create_task(
+                self._expire(self.limits.session_expiry)
+            )
+        else:
+            asyncio.get_running_loop().create_task(self.ctx.registry.terminate(self, "disconnect"))
+
+    async def _fire_will(self, delay: float) -> None:
+        if delay > 0:
+            await asyncio.sleep(delay)
+        will, self.will = self.will, None
+        if will is None:
+            return
+        msg = Message(
+            topic=will.topic,
+            payload=will.payload,
+            qos=will.qos,
+            retain=will.retain,
+            properties=dict(will.properties),
+            from_id=self.id,
+        )
+        if will.retain:
+            self.ctx.retain.set(will.topic, msg)
+        await self.ctx.registry.forwards(msg)
+
+    async def _expire(self, delay: float) -> None:
+        await asyncio.sleep(delay)
+        await self.ctx.registry.terminate(self, "expired")
+
+    def on_reconnect(self) -> None:
+        """Cancel pending offline timers (resumed before expiry)."""
+        if self._expiry_task is not None:
+            self._expiry_task.cancel()
+            self._expiry_task = None
+        if self._will_task is not None:
+            self._will_task.cancel()
+            self._will_task = None
+
+    def transfer_inflight_to_queue(self) -> None:
+        """Reconnect redelivery: unacked QoS1/2 → front of queue with DUP
+        (session.rs rerelease/reforward :1469-1553)."""
+        items = []
+        for e in self.out_inflight.drain():
+            if e.status is MomentStatus.UNCOMPLETE:
+                # QoS2 already PUBREC'd: must resume with PUBREL, keep in window
+                self.out_inflight.push(e)
+                continue
+            items.append(
+                DeliverItem(
+                    msg=e.msg, qos=e.qos, retain=False, topic_filter="", sub_ids=e.subscription_ids, dup=True
+                )
+            )
+        q = self.deliver_queue.drain()
+        for it in items:
+            self.deliver_queue.push(it)
+        for it in q:
+            self.deliver_queue.push(it)
+
+
+def connect_info_will(ci: ConnectInfo) -> Optional[pk.Will]:
+    return ci.will
+
+
+class SessionState:
+    """The online half: socket ↔ session (session.rs run_loop :308-402)."""
+
+    def __init__(self, ctx, session: Session, reader, writer, codec: MqttCodec) -> None:
+        self.ctx = ctx
+        self.s = session
+        self.reader = reader
+        self.writer = writer
+        self.codec = codec
+        self._wlock = asyncio.Lock()
+        self._alias_in: Dict[int, str] = {}
+        self._last_packet = time.monotonic()
+        self._clean_disconnect = False
+        self._kicked = False
+        self._closing = asyncio.Event()
+        self._disconnect_reason: Optional[int] = None
+
+    # ------------------------------------------------------------------ io
+    async def send(self, packet) -> None:
+        data = self.codec.encode(packet)
+        async with self._wlock:
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def close(self, kicked: bool = False) -> None:
+        self._kicked = self._kicked or kicked
+        self._closing.set()
+
+    # ---------------------------------------------------------------- loop
+    async def run(self) -> None:
+        s = self.s
+        tasks = [
+            asyncio.create_task(self._read_loop(), name=f"read:{s.client_id}"),
+            asyncio.create_task(self._deliver_loop(), name=f"deliver:{s.client_id}"),
+            asyncio.create_task(self._retry_loop(), name=f"retry:{s.client_id}"),
+        ]
+        timeout = self.ctx.fitter.keepalive_timeout(s.limits.keepalive)
+        if timeout > 0:
+            tasks.append(asyncio.create_task(self._keepalive_loop(timeout)))
+        closer = asyncio.create_task(self._closing.wait())
+        try:
+            done, pending = await asyncio.wait(
+                tasks + [closer], return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                if t is not closer and t.exception() is not None and not isinstance(
+                    t.exception(), (ConnectionError, asyncio.IncompleteReadError)
+                ):
+                    self.ctx.metrics.inc("session.loop_errors")
+        finally:
+            for t in tasks + [closer]:
+                t.cancel()
+            try:
+                if self.s.connect_info.protocol == pk.V5 and self._kicked:
+                    from rmqtt_tpu.broker.types import RC_SESSION_TAKEN_OVER
+
+                    await asyncio.wait_for(
+                        self.send(pk.Disconnect(RC_SESSION_TAKEN_OVER)), timeout=1.0
+                    )
+            except Exception:
+                pass
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            await self.ctx.hooks.fire(
+                HookType.CLIENT_DISCONNECTED, s.id, self._reason_string(), None
+            )
+            s.on_disconnect(clean=self._clean_disconnect, kicked=self._kicked)
+
+    def _reason_string(self) -> str:
+        if self._kicked:
+            return "kicked"
+        if self._clean_disconnect:
+            return "by-client"
+        return "socket-closed"
+
+    async def _read_loop(self) -> None:
+        while True:
+            data = await self.reader.read(65536)
+            if not data:
+                return
+            self._last_packet = time.monotonic()
+            try:
+                packets = self.codec.feed(data)
+            except ProtocolViolation:
+                self.ctx.metrics.inc("protocol.errors")
+                return
+            for p in packets:
+                await self._handle(p)
+            if self.codec.pending_error is not None:
+                # a later frame in the chunk was malformed; valid packets
+                # above were processed first
+                self.ctx.metrics.inc("protocol.errors")
+                return
+
+    async def _deliver_loop(self) -> None:
+        s = self.s
+        while True:
+            await s.deliver_queue.wait_nonempty()
+            await s.deliver_queue.throttle()
+            if not s.out_inflight.has_credit():
+                # credit-gated (session.rs:362, inflight.rs:319)
+                await asyncio.sleep(0.01)
+                continue
+            item = s.deliver_queue.pop()
+            if item is None:
+                continue
+            await self._deliver(item)
+
+    async def _deliver(self, item: DeliverItem) -> None:
+        s = self.s
+        msg = item.msg
+        expired = await self.ctx.hooks.fire(
+            HookType.MESSAGE_EXPIRY_CHECK, s.id, msg, initial=msg.is_expired()
+        )
+        if expired:
+            self.ctx.metrics.inc("messages.expired")
+            await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "expired")
+            return
+        props: Dict[int, object] = {
+            k: v
+            for k, v in msg.properties.items()
+            if k in (P.PAYLOAD_FORMAT_INDICATOR, P.CONTENT_TYPE, P.RESPONSE_TOPIC,
+                     P.CORRELATION_DATA, P.USER_PROPERTY)
+        }
+        rem = msg.remaining_expiry()
+        if rem is not None:
+            props[P.MESSAGE_EXPIRY_INTERVAL] = rem
+        if item.sub_ids:
+            props[P.SUBSCRIPTION_IDENTIFIER] = list(item.sub_ids)
+        packet_id = None
+        if item.qos > 0:
+            packet_id = s.out_inflight.alloc_packet_id()
+            if packet_id is None:
+                await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "no-packet-id")
+                return
+            s.out_inflight.push(
+                OutEntry(packet_id, msg, item.qos, subscription_ids=item.sub_ids)
+            )
+        pub = pk.Publish(
+            topic=msg.topic,
+            payload=msg.payload,
+            qos=item.qos,
+            retain=item.retain,
+            dup=item.dup,
+            packet_id=packet_id,
+            properties=props if self.codec.version == pk.V5 else {},
+        )
+        await self.send(pub)
+        self.ctx.metrics.inc("messages.delivered")
+        await self.ctx.hooks.fire(HookType.MESSAGE_DELIVERED, s.id, msg, None)
+
+    async def _retry_loop(self) -> None:
+        s = self.s
+        while True:
+            wait = s.out_inflight.next_retry_in()
+            await asyncio.sleep(wait if wait is not None else s.out_inflight.retry_interval)
+            for e in s.out_inflight.due():
+                if not s.out_inflight.mark_retry(e):
+                    await self.ctx.hooks.fire(
+                        HookType.MESSAGE_DROPPED, s.id, e.msg, "retries-exhausted"
+                    )
+                    continue
+                if e.status is MomentStatus.UNCOMPLETE:
+                    await self.send(pk.Pubrel(e.packet_id))
+                else:
+                    await self.send(
+                        pk.Publish(
+                            topic=e.msg.topic,
+                            payload=e.msg.payload,
+                            qos=e.qos,
+                            dup=True,
+                            packet_id=e.packet_id,
+                            properties={},
+                        )
+                    )
+
+    async def _keepalive_loop(self, timeout: float) -> None:
+        while True:
+            idle = time.monotonic() - self._last_packet
+            if idle >= timeout:
+                proceed = await self.ctx.hooks.fire(
+                    HookType.CLIENT_KEEPALIVE, self.s.id, idle, initial=True
+                )
+                if proceed:
+                    self.ctx.metrics.inc("keepalive.timeouts")
+                    self._closing.set()
+                    return
+            await asyncio.sleep(max(0.05, timeout - idle))
+
+    # ------------------------------------------------------------- dispatch
+    async def _handle(self, p) -> None:
+        s = self.s
+        if isinstance(p, pk.Publish):
+            await self._on_publish(p)
+        elif isinstance(p, pk.Puback):
+            e = s.out_inflight.ack(p.packet_id)
+            if e is not None:
+                await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
+        elif isinstance(p, pk.Pubrec):
+            e = s.out_inflight.pubrec(p.packet_id)
+            if e is not None:
+                await self.send(pk.Pubrel(p.packet_id))
+            elif self.codec.version == pk.V5:
+                await self.send(pk.Pubrel(p.packet_id, RC_PACKET_ID_NOT_FOUND))
+        elif isinstance(p, pk.Pubcomp):
+            e = s.out_inflight.ack(p.packet_id)
+            if e is not None:
+                await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
+        elif isinstance(p, pk.Pubrel):
+            s.in_qos2.remove(p.packet_id)
+            await self.send(pk.Pubcomp(p.packet_id))
+        elif isinstance(p, pk.Subscribe):
+            await self._on_subscribe(p)
+        elif isinstance(p, pk.Unsubscribe):
+            await self._on_unsubscribe(p)
+        elif isinstance(p, pk.Pingreq):
+            await self.ctx.hooks.fire(HookType.CLIENT_KEEPALIVE, s.id, 0.0, initial=True)
+            await self.send(pk.Pingresp())
+        elif isinstance(p, pk.Disconnect):
+            from rmqtt_tpu.broker.types import RC_DISCONNECT_WITH_WILL
+
+            self._clean_disconnect = p.reason_code != RC_DISCONNECT_WITH_WILL
+            self._disconnect_reason = p.reason_code
+            self._closing.set()
+        elif isinstance(p, pk.Auth):
+            pass  # enhanced auth not supported yet
+        elif isinstance(p, pk.Connect):
+            # second CONNECT is a protocol error (MQTT-3.1.0-2)
+            self._closing.set()
+
+    # -------------------------------------------------------------- publish
+    async def _on_publish(self, p: pk.Publish) -> None:
+        s = self.s
+        self.ctx.metrics.inc("publish.received")
+        # v5 topic alias resolution (session.rs:994-998)
+        if self.codec.version == pk.V5:
+            alias = p.properties.get(P.TOPIC_ALIAS)
+            if alias is not None:
+                if not (1 <= int(alias) <= s.limits.max_topic_aliases_in):
+                    await self._disconnect_with(RC_TOPIC_ALIAS_INVALID)
+                    return
+                if p.topic:
+                    self._alias_in[int(alias)] = p.topic
+                else:
+                    topic = self._alias_in.get(int(alias))
+                    if topic is None:
+                        await self._disconnect_with(RC_TOPIC_ALIAS_INVALID)
+                        return
+                    p.topic = topic
+        if p.qos > self.ctx.cfg.max_qos:
+            await self._disconnect_with(RC_UNSPECIFIED_ERROR)
+            return
+        # QoS2 ingress dedup (session.rs:908-963)
+        if p.qos == 2:
+            if p.packet_id in s.in_qos2:
+                await self.send(pk.Pubrec(p.packet_id))
+                return
+            if not s.in_qos2.add(p.packet_id):
+                from rmqtt_tpu.broker.types import RC_RECEIVE_MAX_EXCEEDED
+
+                await self.send(pk.Pubrec(p.packet_id, RC_RECEIVE_MAX_EXCEEDED))
+                return
+        accepted, reason = await self._publish(p)
+        if p.qos == 1:
+            await self.send(pk.Puback(p.packet_id, reason if self.codec.version == pk.V5 else 0))
+        elif p.qos == 2:
+            if not accepted:
+                s.in_qos2.remove(p.packet_id)
+            await self.send(pk.Pubrec(p.packet_id, reason if self.codec.version == pk.V5 else 0))
+
+    async def _publish(self, p: pk.Publish) -> Tuple[bool, int]:
+        """The ingress pipeline (session.rs _publish :966-1064)."""
+        s = self.s
+        delay_secs = None
+        topic = p.topic
+        try:
+            delay_secs, topic = parse_delayed(topic)
+        except ValueError:
+            return False, RC_TOPIC_NAME_INVALID
+        if not topic_valid(topic):
+            return False, RC_TOPIC_NAME_INVALID
+        msg = Message.from_publish(p, from_id=s.id)
+        msg = replace(msg, topic=topic, delay_interval=delay_secs)
+        if s.limits.max_message_expiry > 0:
+            cap = s.limits.max_message_expiry
+            if msg.expiry_interval is None or msg.expiry_interval > cap:
+                msg = replace(msg, expiry_interval=cap)
+        # hook may transform the message (message_publish, session.rs:1008)
+        hooked = await self.ctx.hooks.fire(HookType.MESSAGE_PUBLISH, s.id, msg, initial=msg)
+        if hooked is None:
+            return False, RC_UNSPECIFIED_ERROR
+        msg = hooked
+        # ACL (message_publish_check_acl, session.rs:1011-1032)
+        from rmqtt_tpu.broker.acl import Action
+
+        acl = self.ctx.acl.check(
+            Action.PUBLISH, msg.topic, s.connect_info.username, s.client_id
+        )
+        allow = await self.ctx.hooks.fire(
+            HookType.MESSAGE_PUBLISH_CHECK_ACL, s.id, msg, initial=acl.allow
+        )
+        if not allow:
+            self.ctx.metrics.inc("publish.acl_denied")
+            await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "acl-denied")
+            return False, RC_NOT_AUTHORIZED
+        if msg.retain:
+            if not self.ctx.retain.set(msg.topic, msg):
+                self.ctx.metrics.inc("retain.refused")
+        if delay_secs is not None:
+            stripped = replace(msg, retain=False)
+            if not self.ctx.delayed.push(delay_secs, stripped):
+                await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "delayed-cap")
+                return False, RC_UNSPECIFIED_ERROR
+            return True, RC_SUCCESS
+        count = await self.ctx.registry.forwards(msg)
+        if count == 0:
+            await self.ctx.hooks.fire(HookType.MESSAGE_NONSUBSCRIBED, s.id, msg, None)
+            return True, RC_NO_MATCHING_SUBSCRIBERS
+        return True, RC_SUCCESS
+
+    async def _disconnect_with(self, reason: int) -> None:
+        if self.codec.version == pk.V5:
+            try:
+                await self.send(pk.Disconnect(reason))
+            except Exception:
+                pass
+        self._closing.set()
+
+    # ------------------------------------------------------------ subscribe
+    async def _on_subscribe(self, p: pk.Subscribe) -> None:
+        s = self.s
+        codes = []
+        sub_id = None
+        if self.codec.version == pk.V5:
+            sids = p.properties.get(P.SUBSCRIPTION_IDENTIFIER)
+            if sids:
+                sub_id = int(sids[0])
+        for tf, opts in p.filters:
+            codes.append(await self._subscribe_one(tf, opts, sub_id))
+        await self.send(pk.Suback(p.packet_id, codes))
+
+    async def _subscribe_one(self, topic_filter: str, opts: pk.SubOpts, sub_id) -> int:
+        """session.rs _subscribe :1276-1371."""
+        s = self.s
+        cfg = self.ctx.cfg
+        try:
+            group, stripped = parse_shared(topic_filter)
+        except InvalidSharedFilter:
+            return RC_TOPIC_FILTER_INVALID
+        if group is not None and not cfg.shared_subscription:
+            from rmqtt_tpu.broker.types import RC_SHARED_SUB_NOT_SUPPORTED
+
+            return RC_SHARED_SUB_NOT_SUPPORTED
+        if not filter_valid(stripped):
+            return RC_TOPIC_FILTER_INVALID
+        if cfg.max_subscriptions and len(s.subscriptions) >= cfg.max_subscriptions:
+            from rmqtt_tpu.broker.types import RC_QUOTA_EXCEEDED
+
+            return RC_QUOTA_EXCEEDED
+        if cfg.max_topic_levels and len(split_levels(stripped)) > cfg.max_topic_levels:
+            return RC_TOPIC_FILTER_INVALID
+        # hook + ACL (client_subscribe / client_subscribe_check_acl)
+        await self.ctx.hooks.fire(HookType.CLIENT_SUBSCRIBE, s.id, topic_filter, None)
+        from rmqtt_tpu.broker.acl import Action
+
+        acl = self.ctx.acl.check(
+            Action.SUBSCRIBE, stripped, s.connect_info.username, s.client_id
+        )
+        allow = await self.ctx.hooks.fire(
+            HookType.CLIENT_SUBSCRIBE_CHECK_ACL, s.id, topic_filter, initial=acl.allow
+        )
+        if not allow:
+            return RC_NOT_AUTHORIZED
+        qos = min(opts.qos, cfg.max_qos)
+        sopts = SubscriptionOptions(
+            qos=qos,
+            no_local=opts.no_local,
+            retain_as_published=opts.retain_as_published,
+            retain_handling=opts.retain_handling,
+            subscription_ids=(sub_id,) if sub_id is not None else (),
+            shared_group=group,
+        )
+        is_new = topic_filter not in s.subscriptions
+        self.ctx.registry.subscribe(s, topic_filter, stripped, sopts)
+        await self.ctx.hooks.fire(HookType.SESSION_SUBSCRIBED, s.id, topic_filter, None)
+        # retained replay (session.rs:1344-1365; retain-handling v5 3.8.3.1)
+        if group is None and self._should_send_retained(opts, is_new):
+            asyncio.get_running_loop().create_task(
+                self._send_retained(stripped, sopts)
+            )
+        return qos
+
+    def _should_send_retained(self, opts: pk.SubOpts, is_new: bool) -> bool:
+        if not self.ctx.retain.enable:
+            return False
+        if self.codec.version != pk.V5:
+            return True
+        if opts.retain_handling == 0:
+            return True
+        if opts.retain_handling == 1:
+            return is_new
+        return False
+
+    async def _send_retained(self, topic_filter: str, sopts: SubscriptionOptions) -> None:
+        for _topic, msg in self.ctx.retain.matches(topic_filter):
+            item = DeliverItem(
+                msg=msg,
+                qos=min(sopts.qos, msg.qos),
+                retain=True,  # retained replay always sets RETAIN (3.3.1-8)
+                topic_filter=topic_filter,
+                sub_ids=sopts.subscription_ids,
+            )
+            self.s.enqueue(item)
+
+    async def _on_unsubscribe(self, p: pk.Unsubscribe) -> None:
+        s = self.s
+        codes = []
+        for tf in p.filters:
+            await self.ctx.hooks.fire(HookType.CLIENT_UNSUBSCRIBE, s.id, tf, None)
+            ok = self.ctx.registry.unsubscribe(s, tf)
+            if ok:
+                await self.ctx.hooks.fire(HookType.SESSION_UNSUBSCRIBED, s.id, tf, None)
+            codes.append(RC_SUCCESS if ok else 0x11)  # 0x11 = no subscription existed
+        await self.send(pk.Unsuback(p.packet_id, codes))

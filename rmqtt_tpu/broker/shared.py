@@ -1,0 +1,147 @@
+"""Session registry + message fan-out (the `Shared`/`Entry` seam).
+
+Mirrors `/root/reference/rmqtt/src/shared.rs`: the client-id → session
+registry with the kick/takeover protocol (``LockEntry`` :337-634, kick via
+oneshot :480-506), subscribe/unsubscribe through the router (:555-574), and
+``forwards`` — publish → router matches → per-subscriber enqueue with
+QoS-min / retain-as-published / subscription-ids (:735-963). p2p publishes
+short-circuit the router (:743-769).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Iterable, List, Optional
+
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.session import DeliverItem, Session
+from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+
+class SessionRegistry:
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self._sessions: Dict[str, Session] = {}
+
+    # ------------------------------------------------------------- registry
+    def get(self, client_id: str) -> Optional[Session]:
+        return self._sessions.get(client_id)
+
+    def sessions(self) -> Iterable[Session]:
+        return list(self._sessions.values())
+
+    def session_count(self) -> int:
+        return len(self._sessions)
+
+    def connected_count(self) -> int:
+        return sum(1 for s in self._sessions.values() if s.connected)
+
+    async def take_or_create(
+        self, ctx, id: Id, connect_info, limits, clean_start: bool
+    ) -> tuple[Session, bool]:
+        """Takeover/kick + create-or-resume (v5.rs:243-299, shared.rs:480-523).
+
+        Returns (session, session_present).
+        """
+        existing = self._sessions.get(id.client_id)
+        if existing is not None:
+            if existing.connected and existing.state is not None:
+                await existing.state.close(kicked=True)
+                # wait briefly for the old loop to unwind
+                for _ in range(100):
+                    if not existing.connected:
+                        break
+                    await asyncio.sleep(0.01)
+            existing.on_reconnect()
+            if not clean_start and existing.limits.session_expiry > 0:
+                # resume: keep subscriptions, queue, inflight
+                existing.connect_info = connect_info
+                existing.limits = limits
+                existing.clean_start = clean_start
+                existing.will = connect_info.will
+                existing.transfer_inflight_to_queue()
+                return existing, True
+            await self.terminate(existing, "takeover-clean")
+        session = Session(ctx, id, connect_info, limits, clean_start)
+        self._sessions[id.client_id] = session
+        await ctx.hooks.fire(HookType.SESSION_CREATED, id, None, None)
+        return session, False
+
+    async def terminate(self, session: Session, reason: str) -> None:
+        """Remove the session + its router entries (SessionTerminated path)."""
+        cur = self._sessions.get(session.client_id)
+        if cur is not session:
+            return  # already replaced by a newer session
+        del self._sessions[session.client_id]
+        for full_filter, opts in list(session.subscriptions.items()):
+            from rmqtt_tpu.core.topic import parse_shared
+
+            try:
+                _, stripped = parse_shared(full_filter)
+            except Exception:
+                stripped = full_filter
+            self.ctx.router.remove(stripped, session.id)
+        session.subscriptions.clear()
+        await self.ctx.hooks.fire(HookType.SESSION_TERMINATED, session.id, reason, None)
+
+    # ------------------------------------------------------------ sub/unsub
+    def subscribe(
+        self, session: Session, full_filter: str, stripped: str, opts: SubscriptionOptions
+    ) -> None:
+        """Router add + session bookkeeping (shared.rs:555-574)."""
+        self.ctx.router.add(stripped, session.id, opts)
+        session.subscriptions[full_filter] = opts
+
+    def unsubscribe(self, session: Session, full_filter: str) -> bool:
+        from rmqtt_tpu.core.topic import parse_shared
+
+        opts = session.subscriptions.pop(full_filter, None)
+        if opts is None:
+            return False
+        try:
+            _, stripped = parse_shared(full_filter)
+        except Exception:
+            stripped = full_filter
+        self.ctx.router.remove(stripped, session.id)
+        return True
+
+    # --------------------------------------------------------------- fanout
+    async def forwards(self, msg: Message) -> int:
+        """Route + deliver; returns the number of target subscribers
+        (shared.rs `forwards` :735-820 → `forwards_to` :876-963)."""
+        # p2p short-circuit (shared.rs:743-769)
+        if msg.target_clientid is not None:
+            target = self._sessions.get(msg.target_clientid)
+            if target is None:
+                return 0
+            target.enqueue(
+                DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter="")
+            )
+            return 1
+        relmap = await self.ctx.routing.matches(msg.from_id, msg.topic)
+        count = 0
+        for node_id, relations in relmap.items():
+            # single-node: everything is local; cluster mode dispatches
+            # remote nodes over the cluster backend (round 2+)
+            for rel in relations:
+                count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+        return count
+
+    def _deliver_local(
+        self, client_id: str, topic_filter: str, opts: SubscriptionOptions, msg: Message
+    ) -> int:
+        session = self._sessions.get(client_id)
+        if session is None:
+            return 0
+        retain = msg.retain if opts.retain_as_published else False
+        session.enqueue(
+            DeliverItem(
+                msg=msg,
+                qos=min(opts.qos, msg.qos),
+                retain=retain,
+                topic_filter=topic_filter,
+                sub_ids=opts.subscription_ids,
+            )
+        )
+        return 1

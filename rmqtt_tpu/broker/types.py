@@ -73,6 +73,7 @@ class ConnectInfo:
     password: Optional[bytes] = None
     properties: Dict[int, object] = field(default_factory=dict)
     remote_addr: Optional[Tuple[str, int]] = None
+    will: Optional[pk.Will] = None
 
 
 # --- v5 reason codes used by broker paths (MQTT-5.0 2.4) ---

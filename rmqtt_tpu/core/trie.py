@@ -26,6 +26,8 @@ V = TypeVar("V", bound=Hashable)
 
 
 class _Node(Generic[V]):
+    """TopicTree node: multi-value set + branches."""
+
     __slots__ = ("values", "branches")
 
     def __init__(self) -> None:
@@ -34,6 +36,20 @@ class _Node(Generic[V]):
 
     def is_empty(self) -> bool:
         return not self.values and not self.branches
+
+
+class _RNode(Generic[V]):
+    """RetainTree node: one (possibly unhashable) value slot + branches."""
+
+    __slots__ = ("value", "has_value", "branches")
+
+    def __init__(self) -> None:
+        self.value: Optional[V] = None
+        self.has_value = False
+        self.branches: Dict[str, _RNode[V]] = {}
+
+    def is_empty(self) -> bool:
+        return not self.has_value and not self.branches
 
 
 class TopicTree(Generic[V]):
@@ -177,7 +193,7 @@ class RetainTree(Generic[V]):
     """
 
     def __init__(self) -> None:
-        self._root: _Node[V] = _Node()
+        self._root: _RNode[V] = _RNode()
         self._count = 0
 
     def insert(self, topic: str | Sequence[str], value: V) -> Optional[V]:
@@ -186,19 +202,19 @@ class RetainTree(Generic[V]):
         for lev in as_levels(topic):
             nxt = node.branches.get(lev)
             if nxt is None:
-                nxt = _Node()
+                nxt = _RNode()
                 node.branches[lev] = nxt
             node = nxt
-        had_value = bool(node.values)
-        prev = next(iter(node.values)) if had_value else None
-        if not had_value:
+        prev = node.value if node.has_value else None
+        if not node.has_value:
             self._count += 1
-        node.values = {value}
+        node.value = value
+        node.has_value = True
         return prev
 
     def remove(self, topic: str | Sequence[str]) -> Optional[V]:
         levels = as_levels(topic)
-        path: List[Tuple[_Node[V], str]] = []
+        path: List[Tuple[_RNode[V], str]] = []
         node = self._root
         for lev in levels:
             nxt = node.branches.get(lev)
@@ -206,10 +222,11 @@ class RetainTree(Generic[V]):
                 return None
             path.append((node, lev))
             node = nxt
-        if not node.values:
+        if not node.has_value:
             return None
-        prev = next(iter(node.values))
-        node.values = set()
+        prev = node.value
+        node.value = None
+        node.has_value = False
         self._count -= 1
         for parent, lev in reversed(path):
             child = parent.branches[lev]
@@ -225,7 +242,7 @@ class RetainTree(Generic[V]):
             node = node.branches.get(lev)  # type: ignore[assignment]
             if node is None:
                 return None
-        return next(iter(node.values)) if node.values else None
+        return node.value if node.has_value else None
 
     def count(self) -> int:
         return self._count
@@ -237,9 +254,15 @@ class RetainTree(Generic[V]):
         self._rmatch(self._root, filt, 0, [], out)
         return out
 
-    def _collect_all(self, node: _Node[V], prefix: List[str], out, skip_meta_first: bool) -> None:
-        if node.values:
-            out.append((tuple(prefix), next(iter(node.values))))
+    def items(self) -> List[Tuple[Tuple[str, ...], V]]:
+        """All stored (topic_levels, value) pairs, including ``$``-topics."""
+        out: List[Tuple[Tuple[str, ...], V]] = []
+        self._collect_all(self._root, [], out, skip_meta_first=False)
+        return out
+
+    def _collect_all(self, node: _RNode[V], prefix: List[str], out, skip_meta_first: bool) -> None:
+        if node.has_value:
+            out.append((tuple(prefix), node.value))
         for lev, child in node.branches.items():
             if skip_meta_first and not prefix and lev != "" and is_metadata(lev):
                 continue
@@ -249,15 +272,15 @@ class RetainTree(Generic[V]):
 
     def _rmatch(
         self,
-        node: _Node[V],
+        node: _RNode[V],
         filt: List[str],
         i: int,
         prefix: List[str],
         out: List[Tuple[Tuple[str, ...], V]],
     ) -> None:
         if i == len(filt):
-            if node.values:
-                out.append((tuple(prefix), next(iter(node.values))))
+            if node.has_value:
+                out.append((tuple(prefix), node.value))
             return
         lev = filt[i]
         if lev == HASH:

@@ -1,0 +1,206 @@
+"""Minimal asyncio MQTT client for black-box broker tests.
+
+The reference's test harness drives the broker with its own protocol clients
+over raw TCP (`rmqtt-test/src/mqtt/*/client.rs`) — same idea here: this
+client is the fixture, the broker under test is always real (a listening
+socket). Uses the wire codec for framing; a few tests additionally assert
+raw byte sequences to keep the codec honest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+from rmqtt_tpu.broker.codec.packets import SubOpts
+
+
+class TestClient:
+    def __init__(self, reader, writer, codec, version) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.codec = codec
+        self.version = version
+        self.publishes: asyncio.Queue = asyncio.Queue()
+        self._acks: Dict[tuple, asyncio.Future] = {}
+        self.connack: Optional[pk.Connack] = None
+        self.disconnect: Optional[pk.Disconnect] = None
+        self._pid = 0
+        self._task: Optional[asyncio.Task] = None
+        self.auto_ack = True
+        self.closed = asyncio.Event()
+
+    # ------------------------------------------------------------- connect
+    @classmethod
+    async def connect(
+        cls,
+        port: int,
+        client_id: str = "",
+        version: int = pk.V311,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        will: Optional[pk.Will] = None,
+        properties: Optional[dict] = None,
+        host: str = "127.0.0.1",
+    ) -> "TestClient":
+        reader, writer = await asyncio.open_connection(host, port)
+        codec = MqttCodec(version)
+        client = cls(reader, writer, codec, version)
+        writer.write(
+            codec.encode(
+                pk.Connect(
+                    client_id=client_id,
+                    protocol=version,
+                    clean_start=clean_start,
+                    keepalive=keepalive,
+                    username=username,
+                    password=password,
+                    will=will,
+                    properties=properties or {},
+                )
+            )
+        )
+        await writer.drain()
+        client._task = asyncio.create_task(client._read_loop())
+        client.connack = await client._wait(("connack",), timeout=5.0)
+        return client
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 65535 + 1
+        return self._pid
+
+    async def _wait(self, key: tuple, timeout: float = 5.0):
+        fut = asyncio.get_running_loop().create_future()
+        self._acks[key] = fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._acks.pop(key, None)
+
+    def _resolve(self, key: tuple, value) -> None:
+        fut = self._acks.get(key)
+        if fut is not None and not fut.done():
+            fut.set_result(value)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                for p in self.codec.feed(data):
+                    await self._on_packet(p)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed.set()
+
+    async def _on_packet(self, p) -> None:
+        if isinstance(p, pk.Connack):
+            self._resolve(("connack",), p)
+        elif isinstance(p, pk.Publish):
+            if self.auto_ack:
+                if p.qos == 1:
+                    await self._send(pk.Puback(p.packet_id))
+                elif p.qos == 2:
+                    await self._send(pk.Pubrec(p.packet_id))
+            await self.publishes.put(p)
+        elif isinstance(p, pk.Puback):
+            self._resolve(("puback", p.packet_id), p)
+        elif isinstance(p, pk.Pubrec):
+            await self._send(pk.Pubrel(p.packet_id))
+        elif isinstance(p, pk.Pubcomp):
+            self._resolve(("pubcomp", p.packet_id), p)
+        elif isinstance(p, pk.Pubrel):
+            await self._send(pk.Pubcomp(p.packet_id))
+        elif isinstance(p, pk.Suback):
+            self._resolve(("suback", p.packet_id), p)
+        elif isinstance(p, pk.Unsuback):
+            self._resolve(("unsuback", p.packet_id), p)
+        elif isinstance(p, pk.Pingresp):
+            self._resolve(("pingresp",), p)
+        elif isinstance(p, pk.Disconnect):
+            self.disconnect = p
+
+    async def _send(self, p) -> None:
+        self.writer.write(self.codec.encode(p))
+        await self.writer.drain()
+
+    # ------------------------------------------------------------ commands
+    async def subscribe(self, *filters, qos: int = 1, opts: Optional[SubOpts] = None,
+                        properties: Optional[dict] = None) -> pk.Suback:
+        pid = self._next_pid()
+        subs = [(f, opts or SubOpts(qos=qos)) for f in filters]
+        await self._send(pk.Subscribe(pid, subs, properties or {}))
+        return await self._wait(("suback", pid))
+
+    async def unsubscribe(self, *filters) -> pk.Unsuback:
+        pid = self._next_pid()
+        await self._send(pk.Unsubscribe(pid, list(filters)))
+        return await self._wait(("unsuback", pid))
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[dict] = None,
+        wait_ack: bool = True,
+    ):
+        pid = self._next_pid() if qos else None
+        p = pk.Publish(
+            topic=topic, payload=payload, qos=qos, retain=retain,
+            packet_id=pid, properties=properties or {},
+        )
+        await self._send(p)
+        if qos == 1 and wait_ack:
+            return await self._wait(("puback", pid))
+        if qos == 2 and wait_ack:
+            return await self._wait(("pubcomp", pid))
+        return None
+
+    async def recv(self, timeout: float = 3.0) -> pk.Publish:
+        return await asyncio.wait_for(self.publishes.get(), timeout)
+
+    async def expect_nothing(self, timeout: float = 0.4) -> None:
+        try:
+            p = await asyncio.wait_for(self.publishes.get(), timeout)
+        except asyncio.TimeoutError:
+            return
+        raise AssertionError(f"unexpected publish: {p}")
+
+    async def ping(self) -> pk.Pingresp:
+        await self._send(pk.Pingreq())
+        return await self._wait(("pingresp",))
+
+    async def disconnect_clean(self, reason: int = 0) -> None:
+        try:
+            await self._send(pk.Disconnect(reason))
+        except ConnectionError:
+            pass
+        await self.close()
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    def abort(self) -> None:
+        """Abrupt socket kill (no DISCONNECT) — triggers the will path."""
+        if self._task is not None:
+            self._task.cancel()
+        sock = self.writer.get_extra_info("socket")
+        try:
+            import socket as _s
+
+            sock.setsockopt(_s.SOL_SOCKET, _s.SO_LINGER, b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        except Exception:
+            pass
+        self.writer.transport.abort()

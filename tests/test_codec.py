@@ -179,3 +179,21 @@ def test_connect_reserved_flag():
 def test_unsub_no_filters_rejected():
     with pytest.raises(ProtocolError):
         MqttCodec(pk.V311).feed(bytes([0xA2, 0x02, 0x00, 0x01]))
+
+
+def test_valid_packets_before_malformed_frame_survive():
+    enc = MqttCodec(pk.V311)
+    good = enc.encode(Publish(topic="t", payload=b"ok", qos=1, packet_id=1))
+    bad = bytes([0x06, 0x00])  # unknown packet type in the same chunk
+    dec = MqttCodec(pk.V311)
+    out = dec.feed(good + bad)
+    assert len(out) == 1 and out[0].payload == b"ok"
+    assert dec.pending_error is not None
+    with pytest.raises(ProtocolError):
+        dec.feed(b"")  # poisoned codec refuses further input
+
+
+def test_client_side_codec_version_follows_encoded_connect():
+    c = MqttCodec()  # defaults to v3.1.1
+    c.encode(Connect(client_id="c", protocol=pk.V5))
+    assert c.version == pk.V5
