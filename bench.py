@@ -100,18 +100,24 @@ def gen_topics_zipf(rng, n, depth=6, a=1.3):
 # ---------------------------------------------------------------- measurement
 
 
-def build_tpu_table(filters):
+def build_tpu_table(filters, kind="dense"):
     from rmqtt_tpu.core.topic import parse_shared
-    from rmqtt_tpu.ops.encode import FilterTable
 
-    table = FilterTable()
+    if kind == "dense":
+        from rmqtt_tpu.ops.encode import FilterTable
+
+        table = FilterTable()
+    else:
+        from rmqtt_tpu.ops.partitioned import PartitionedTable
+
+        table = PartitionedTable()
     fids = {}
     t0 = time.perf_counter()
     for f in filters:
         _, stripped = parse_shared(f)
         fids[table.add(stripped)] = stripped
-    log(f"  table build: {len(filters)} filters in {time.perf_counter() - t0:.2f}s "
-        f"(cap={table.capacity}, L={table.max_levels}, vocab={len(table.tokens)})")
+    log(f"  {kind} table build: {len(filters)} filters in {time.perf_counter() - t0:.2f}s "
+        f"(L={table.max_levels}, vocab={len(table.tokens)})")
     return table, fids
 
 
@@ -128,11 +134,17 @@ def build_cpu_tree(filters):
     return tree
 
 
-def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8):
-    """End-to-end topics/sec + per-batch latency through TpuMatcher.match."""
+def make_matcher(table):
+    from rmqtt_tpu.ops.encode import FilterTable
     from rmqtt_tpu.ops.match import TpuMatcher
+    from rmqtt_tpu.ops.partitioned import PartitionedMatcher
 
-    matcher = TpuMatcher(table)
+    return TpuMatcher(table) if isinstance(table, FilterTable) else PartitionedMatcher(table)
+
+
+def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8):
+    """End-to-end topics/sec + per-batch latency through the batched matcher."""
+    matcher = make_matcher(table)
     batches = [topics[i : i + batch_size] for i in range(0, len(topics), batch_size)]
     batches = [b for b in batches if len(b) == batch_size]
     if len(batches) < warmup + min_batches:
@@ -187,9 +199,7 @@ def measure_cpu(tree, topics, sample, time_budget_s=20.0):
 
 def spot_check(table, fids, tree, topics, n=32):
     """Correctness: TPU fids ≡ trie values on a topic sample."""
-    from rmqtt_tpu.ops.match import TpuMatcher
-
-    matcher = TpuMatcher(table)
+    matcher = make_matcher(table)
     sample = topics[:n]
     rows = matcher.match(sample)
     for topic, row in zip(sample, rows):
@@ -206,18 +216,33 @@ def spot_check(table, fids, tree, topics, n=32):
 
 def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
     log(f"[{name}] {len(filters)} subs, {len(topics)} publish topics")
-    table, fids = build_tpu_table(filters)
     tree = build_cpu_tree(filters)
-    spot_check(table, fids, tree, topics)
-    tpu = measure_tpu(table, topics, batch_size)
     cpu = measure_cpu(tree, topics, cpu_sample)
-    res = {"name": name, "tpu": tpu, "cpu": cpu, "speedup": tpu["topics_per_sec"] / cpu["topics_per_sec"]}
-    if retained is not None:
-        res["retained"] = run_retained(table, retained, topics)
+    variants = {}
+    for kind in ("partitioned", "dense"):
+        table, fids = build_tpu_table(filters, kind)
+        spot_check(table, fids, tree, topics)
+        variants[kind] = measure_tpu(table, topics, batch_size)
+        if retained is not None and kind == "dense":
+            variants["retained"] = run_retained(table, retained, topics)
+        del table, fids
+    best_kind = max(("partitioned", "dense"), key=lambda k: variants[k]["topics_per_sec"])
+    tpu = variants[best_kind]
+    res = {
+        "name": name,
+        "tpu": tpu,
+        "tpu_backend": best_kind,
+        "variants": variants,
+        "cpu": cpu,
+        "speedup": tpu["topics_per_sec"] / cpu["topics_per_sec"],
+    }
+    if "retained" in variants:
+        res["retained"] = variants.pop("retained")
     log(
-        f"[{name}] TPU {tpu['topics_per_sec']:.0f} topics/s ({tpu['routes_per_sec']:.0f} routes/s, "
-        f"p50 {tpu['p50_ms']:.1f}ms p99 {tpu['p99_ms']:.1f}ms) | "
-        f"CPU {cpu['topics_per_sec']:.0f} topics/s | speedup {res['speedup']:.1f}x"
+        f"[{name}] TPU[{best_kind}] {tpu['topics_per_sec']:.0f} topics/s "
+        f"({tpu['routes_per_sec']:.0f} routes/s, p50 {tpu['p50_ms']:.1f}ms "
+        f"p99 {tpu['p99_ms']:.1f}ms) | CPU {cpu['topics_per_sec']:.0f} topics/s "
+        f"| speedup {res['speedup']:.2f}x"
     )
     return res
 
@@ -252,15 +277,45 @@ def run_retained(sub_table, retained_topics, publish_topics):
     }
 
 
+def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
+    """Probe the TPU in a subprocess: the axon grant can be wedged by a
+    previously-killed client, in which case jax.devices() blocks forever
+    in-process (NOTES.md). A subprocess probe can be timed out safely."""
+    import subprocess
+
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=probe_timeout,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < retries:
+            log(f"tpu probe attempt {attempt + 1}/{retries} failed; retrying")
+            time.sleep(15)
+    return False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
     ap.add_argument("--config", type=int, default=None, help="run a single config 1-5")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu or not tpu_available():
+        if not args.cpu:
+            log("TPU unreachable — falling back to CPU platform (reduced sizes)")
+        jax.config.update("jax_platforms", "cpu")
+        args.smoke = args.smoke or args.config is None  # keep CPU runs small
 
     rng = random.Random(args.seed)
     platform = jax.devices()[0].platform

@@ -42,6 +42,7 @@ class BrokerConfig:
     shared_subscription: bool = True
     batch_max: int = 1024
     batch_linger_ms: float = 1.0
+    cluster: bool = False  # use the cluster-aware session registry
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -73,11 +74,19 @@ class ServerContext:
             router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
         )
         self.retain = RetainStore(enable=self.cfg.retain_enable, max_retained=self.cfg.retain_max)
-        self.registry = SessionRegistry(self)
+        if self.cfg.cluster:
+            from rmqtt_tpu.cluster.broadcast import ClusterSessionRegistry
+
+            self.registry = ClusterSessionRegistry(self)
+        else:
+            self.registry = SessionRegistry(self)
         self.delayed = DelayedSender(self.registry.forwards, max_pending=self.cfg.delayed_publish_max)
         self.acl = acl or AclEngine()
         self.fitter = Fitter(self.cfg.fitter)
         self.node_id = self.cfg.node_id
+        from rmqtt_tpu.plugins import PluginManager
+
+        self.plugins = PluginManager(self)
 
     def start(self) -> None:
         self.routing.start()

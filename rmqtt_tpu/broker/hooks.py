@@ -57,8 +57,10 @@ class HookResult:
     value: Any = None
 
 
-# handler(htype, args tuple, prev value) → HookResult | None (None = pass-through)
-Handler = Callable[..., Awaitable[Optional[HookResult]]]
+# handler(htype, args: tuple, prev) → HookResult | None (None = pass-through).
+# `args` arrives as ONE tuple so hook types can carry any payload arity
+# without breaking handlers (the reference's typed Parameter enum flattened).
+Handler = Callable[[Any, tuple, Any], Awaitable[Optional[HookResult]]]
 
 _seq = itertools.count()
 
@@ -92,7 +94,7 @@ class HookRegistry:
         """Run the chain; returns the final value (hook.rs:73-110 semantics)."""
         value = initial
         for handler in self.handlers(htype):
-            res = await handler(htype, *args, value)
+            res = await handler(htype, args, value)
             if res is None:
                 continue
             value = res.value if res.value is not None else value

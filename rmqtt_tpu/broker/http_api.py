@@ -1,0 +1,249 @@
+"""HTTP management API.
+
+Mirrors the reference's `rmqtt-http-api` plugin surface
+(`rmqtt-plugins/rmqtt-http-api/src/api.rs:73-203`): REST endpoints for
+brokers/nodes/health/clients/subscriptions/routes/stats/metrics, publish and
+subscribe management calls, plus a Prometheus text endpoint
+(`src/prome.rs:16-300`). Implemented on asyncio + http.server-free manual
+HTTP/1.1 (no external deps), sharing the broker's ServerContext.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from rmqtt_tpu import __version__
+from rmqtt_tpu.broker.types import Message, now
+from rmqtt_tpu.router.base import Id
+
+log = logging.getLogger("rmqtt_tpu.http")
+
+_STARTED_AT = time.time()
+
+
+class HttpApi:
+    def __init__(self, ctx, host: str = "127.0.0.1", port: int = 6060) -> None:
+        self.ctx = ctx
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        log.info("http api on %s:%s", self.host, self.bound_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------- plumbing
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await asyncio.wait_for(reader.readline(), 30.0)
+                if not req:
+                    return
+                try:
+                    method, target, _proto = req.decode("latin1").split()
+                except ValueError:
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                length = int(headers.get("content-length", 0))
+                if length:
+                    body = await reader.readexactly(length)
+                status, payload, ctype = await self._route(method, target, body)
+                data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+                writer.write(
+                    b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %d\r\n"
+                    b"Connection: keep-alive\r\n\r\n"
+                    % (status, b"OK" if status < 400 else b"ERR", ctype.encode(), len(data))
+                )
+                writer.write(data)
+                await writer.drain()
+        except (ConnectionError, asyncio.TimeoutError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, target: str, body: bytes) -> Tuple[int, Any, str]:
+        url = urlparse(target)
+        path = unquote(url.path).rstrip("/")
+        q = parse_qs(url.query)
+        try:
+            return await self._dispatch(method, path, q, body)
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"error": f"bad request: {e}"}, "application/json"
+        except Exception as e:
+            log.exception("http api error on %s", path)
+            return 500, {"error": str(e)}, "application/json"
+
+    # ------------------------------------------------------------ endpoints
+    async def _dispatch(self, method: str, path: str, q, body: bytes) -> Tuple[int, Any, str]:
+        ctx = self.ctx
+        J = "application/json"
+        if path in ("/api/v1", "/api/v1/"):
+            return 200, [
+                "/api/v1/brokers", "/api/v1/nodes", "/api/v1/health",
+                "/api/v1/clients", "/api/v1/subscriptions", "/api/v1/routes",
+                "/api/v1/stats", "/api/v1/metrics", "/api/v1/plugins",
+                "/api/v1/mqtt/publish", "/api/v1/mqtt/subscribe",
+                "/api/v1/mqtt/unsubscribe", "/metrics/prometheus",
+            ], J
+        if path == "/api/v1/brokers":
+            return 200, [self._broker_info()], J
+        if path == "/api/v1/nodes":
+            return 200, [self._node_info()], J
+        if path == "/api/v1/health":
+            return 200, {"status": "ok", "node_id": ctx.node_id}, J
+        if path == "/api/v1/clients":
+            limit = int(q.get("_limit", ["100"])[0])
+            return 200, [
+                self._client_info(s) for s in list(ctx.registry.sessions())[:limit]
+            ], J
+        if path.startswith("/api/v1/clients/"):
+            cid = path.rsplit("/", 1)[1]
+            s = ctx.registry.get(cid)
+            if s is None:
+                return 404, {"error": "not found"}, J
+            if method == "DELETE":  # kick (api.rs clients delete)
+                if s.state is not None:
+                    await s.state.close(kicked=True)
+                else:
+                    await ctx.registry.terminate(s, "api-kick")
+                return 200, {"kicked": cid}, J
+            return 200, self._client_info(s), J
+        if path == "/api/v1/subscriptions":
+            limit = int(q.get("_limit", ["100"])[0])
+            out = []
+            for s in ctx.registry.sessions():
+                for tf, opts in s.subscriptions.items():
+                    if len(out) >= limit:
+                        break
+                    out.append({
+                        "client_id": s.client_id, "topic_filter": tf,
+                        "qos": opts.qos, "share": opts.shared_group,
+                    })
+            return 200, out, J
+        if path == "/api/v1/routes":
+            limit = int(q.get("_limit", ["100"])[0])
+            return 200, ctx.router.gets(limit), J
+        if path == "/api/v1/stats":
+            return 200, {"node": ctx.node_id, "stats": ctx.stats().to_json()}, J
+        if path == "/api/v1/metrics":
+            return 200, {"node": ctx.node_id, "metrics": ctx.metrics.to_json()}, J
+        if path == "/api/v1/plugins":
+            plugins = getattr(ctx, "plugins", None)
+            return 200, (plugins.describe() if plugins else []), J
+        if path == "/api/v1/mqtt/publish" and method == "POST":
+            req = json.loads(body or b"{}")
+            payload = req.get("payload", "")
+            msg = Message(
+                topic=req["topic"],
+                payload=payload.encode() if isinstance(payload, str) else bytes(payload),
+                qos=int(req.get("qos", 0)),
+                retain=bool(req.get("retain", False)),
+                from_id=Id(ctx.node_id, req.get("clientid", "http-api")),
+            )
+            if msg.retain:
+                ctx.retain.set(msg.topic, msg)
+            n = await ctx.registry.forwards(msg)
+            return 200, {"delivered_to": n}, J
+        if path == "/api/v1/mqtt/subscribe" and method == "POST":
+            # management-initiated subscribe on behalf of a client (api.rs)
+            req = json.loads(body or b"{}")
+            s = ctx.registry.get(req["clientid"])
+            if s is None:
+                return 404, {"error": "no such client"}, J
+            from rmqtt_tpu.core.topic import filter_valid, parse_shared
+            from rmqtt_tpu.router.base import SubscriptionOptions
+
+            tf = req["topic"]
+            group, stripped = parse_shared(tf)
+            if not filter_valid(stripped):
+                return 400, {"error": "invalid filter"}, J
+            ctx.registry.subscribe(
+                s, tf, stripped,
+                SubscriptionOptions(qos=int(req.get("qos", 0)), shared_group=group),
+            )
+            return 200, {"subscribed": tf}, J
+        if path == "/api/v1/mqtt/unsubscribe" and method == "POST":
+            req = json.loads(body or b"{}")
+            s = ctx.registry.get(req["clientid"])
+            if s is None:
+                return 404, {"error": "no such client"}, J
+            ok = ctx.registry.unsubscribe(s, req["topic"])
+            return 200, {"unsubscribed": bool(ok)}, J
+        if path == "/metrics/prometheus":
+            return 200, self._prometheus().encode(), "text/plain; version=0.0.4"
+        return 404, {"error": "no such endpoint"}, J
+
+    # --------------------------------------------------------------- bodies
+    def _broker_info(self) -> dict:
+        return {
+            "node_id": self.ctx.node_id,
+            "version": __version__,
+            "uptime": round(time.time() - _STARTED_AT, 1),
+            "sysdescr": "rmqtt_tpu broker",
+            "datetime": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+
+    def _node_info(self) -> dict:
+        stats = self.ctx.stats()
+        return {
+            "node_id": self.ctx.node_id,
+            "connections": stats.connections,
+            "sessions": stats.sessions,
+            "subscriptions": stats.subscriptions,
+            "retaineds": stats.retaineds,
+            "version": __version__,
+            "uptime": round(time.time() - _STARTED_AT, 1),
+        }
+
+    def _client_info(self, s) -> dict:
+        return {
+            "clientid": s.client_id,
+            "node_id": s.id.node_id,
+            "connected": s.connected,
+            "protocol": s.connect_info.protocol,
+            "username": s.connect_info.username,
+            "keepalive": s.limits.keepalive,
+            "clean_start": s.clean_start,
+            "session_expiry": s.limits.session_expiry,
+            "subscriptions": len(s.subscriptions),
+            "mqueue_len": len(s.deliver_queue),
+            "inflight": len(s.out_inflight),
+            "created_at": s.created_at,
+            "ip": s.connect_info.remote_addr[0] if s.connect_info.remote_addr else None,
+        }
+
+    def _prometheus(self) -> str:
+        stats = self.ctx.stats().to_json()
+        lines = []
+        for k, v in stats.items():
+            lines.append(f"# TYPE rmqtt_{k} gauge")
+            lines.append(f'rmqtt_{k}{{node="{self.ctx.node_id}"}} {v}')
+        for k, v in self.ctx.metrics.to_json().items():
+            name = "rmqtt_" + k.replace(".", "_")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f'{name}{{node="{self.ctx.node_id}"}} {v}')
+        return "\n".join(lines) + "\n"

@@ -37,17 +37,26 @@ class RetainStore:
         self._scanner = None
         self._rowid_by_topic: Dict[str, int] = {}
         self._msg_by_rowid: Dict[int, Tuple[str, Message]] = {}
+        # cluster hook: called as on_set(topic, msg_or_None) after a local
+        # mutation (broadcast-mode retain_set_broadcast analogue)
+        self.on_set = None
 
     def count(self) -> int:
         return self._tree.count()
 
     def set(self, topic: str, msg: Message) -> bool:
         """Store/replace/clear; returns False if refused (limits/disabled)."""
+        ok = self.set_local(topic, msg)
+        if ok and self.on_set is not None:
+            self.on_set(topic, msg if msg.payload else None)
+        return ok
+
+    def set_local(self, topic: str, msg: Message) -> bool:
+        """Like `set` but without the cluster broadcast (inbound sync path)."""
         if not self.enable:
             return False
         if not msg.payload:  # empty payload clears (MQTT-3.3.1-10)
-            self._tree.remove(topic)
-            self._drop_row(topic)
+            self.remove_local(topic)
             return True
         if len(msg.payload) > self.max_payload:
             return False
@@ -57,6 +66,14 @@ class RetainStore:
         if self._tpu:
             self._set_row(topic, msg)
         return True
+
+    def remove_local(self, topic: str) -> None:
+        self._tree.remove(topic)
+        self._drop_row(topic)
+
+    def all_items(self) -> List[Tuple[str, Message]]:
+        """Every retained (topic, message), including ``$``-topics."""
+        return [("/".join(levels), m) for levels, m in self._tree.items()]
 
     def get(self, topic: str) -> Optional[Message]:
         msg = self._tree.get(topic)

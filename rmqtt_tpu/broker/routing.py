@@ -47,10 +47,16 @@ class RoutingService:
 
     async def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
         fut = asyncio.get_running_loop().create_future()
-        await self._q.put((from_id, topic, fut))
+        await self._q.put((from_id, topic, fut, False))
         return await fut
 
-    async def _collect(self) -> List[Tuple[Optional[Id], str, asyncio.Future]]:
+    async def matches_raw(self, from_id: Optional[Id], topic: str):
+        """Un-collapsed variant for cluster-global shared-group choice."""
+        fut = asyncio.get_running_loop().create_future()
+        await self._q.put((from_id, topic, fut, True))
+        return await fut
+
+    async def _collect(self):
         batch = [await self._q.get()]
         deadline = asyncio.get_running_loop().time() + self.linger
         while len(batch) < self.max_batch:
@@ -67,16 +73,18 @@ class RoutingService:
         loop = asyncio.get_running_loop()
         while True:
             batch = await self._collect()
-            items = [(fid, topic) for fid, topic, _ in batch]
+            items = [(fid, topic) for fid, topic, _, _ in batch]
             try:
-                # matches_batch blocks on device compute; keep the event loop
-                # free (numpy/jax release the GIL for the heavy parts)
-                results = await loop.run_in_executor(None, self.router.matches_batch, items)
+                # matches_batch_raw blocks on device compute; keep the event
+                # loop free (numpy/jax release the GIL for the heavy parts)
+                results = await loop.run_in_executor(
+                    None, self.router.matches_batch_raw, items
+                )
             except Exception as e:  # resolve all waiters with the error
-                for _, _, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
-            for (_, _, fut), res in zip(batch, results):
+            for (_, _, fut, raw), res in zip(batch, results):
                 if not fut.done():
-                    fut.set_result(res)
+                    fut.set_result(res if raw else self.router.collapse(res))

@@ -49,6 +49,7 @@ class MqttBroker:
     async def start(self) -> None:
         await self.ctx.hooks.fire(HookType.BEFORE_STARTUP)
         self.ctx.start()
+        await self.ctx.plugins.start_all()
         self._server = await asyncio.start_server(
             self._on_connection, self.ctx.cfg.host, self.ctx.cfg.port
         )
@@ -64,6 +65,7 @@ class MqttBroker:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        await self.ctx.plugins.stop_all()
         await self.ctx.stop()
 
     async def serve_forever(self) -> None:
@@ -167,14 +169,28 @@ class MqttBroker:
             reason_code=reason if v5 else (V3_ACCEPTED if reason == 0 else reason),
             properties=ack_props,
         )
-        writer.write(codec.encode(connack))
-        await writer.drain()
         if reason != RC_SUCCESS:
+            writer.write(codec.encode(connack))
+            await writer.drain()
             writer.close()
             return
+        # mark the session live BEFORE the CONNACK goes out: the client may
+        # act on the CONNACK immediately (counters/kick/cluster queries race
+        # otherwise)
         state = SessionState(ctx, session, reader, writer, codec)
         session.state = state
         session.connected = True
+        try:
+            writer.write(codec.encode(connack))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            # client vanished mid-handshake: unwind the just-activated
+            # session instead of leaking a zombie 'connected' entry
+            session.connected = False
+            session.state = None
+            session.on_disconnect(clean=False)
+            writer.close()
+            return
         ctx.metrics.inc("connections.established")
         await ctx.hooks.fire(HookType.CLIENT_CONNECTED, ci, None, None)
         try:
@@ -192,9 +208,34 @@ class MqttBroker:
 
 
 async def _amain(args) -> None:
-    cfg = BrokerConfig(host=args.host, port=args.port, router=args.router)
+    cfg = BrokerConfig(
+        host=args.host,
+        port=args.port,
+        node_id=args.node_id,
+        router=args.router,
+        cluster=bool(args.cluster_listen),
+    )
     broker = MqttBroker(ServerContext(cfg))
-    await broker.serve_forever()
+    cluster = None
+    if args.cluster_listen:
+        from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+
+        chost, cport = args.cluster_listen.rsplit(":", 1)
+        peers = []
+        for spec in args.peer or []:
+            # "<node_id>@<host>:<port>" (reference NodeAddr format,
+            # rmqtt-utils/src/lib.rs:121)
+            nid, addr = spec.split("@", 1)
+            phost, pport = addr.rsplit(":", 1)
+            peers.append((int(nid), phost, int(pport)))
+        cluster = BroadcastCluster(broker.ctx, (chost, int(cport)), peers)
+        await cluster.start()
+    await broker.start()
+    if cluster is not None:
+        await cluster.start_sync()
+        log.info("cluster node %s listening on %s", args.node_id, args.cluster_listen)
+    async with broker._server:
+        await broker._server.serve_forever()
 
 
 def main() -> None:
@@ -203,7 +244,13 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="rmqtt_tpu broker")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("--node-id", type=int, default=1)
     ap.add_argument("--router", choices=["trie", "xla"], default="trie")
+    ap.add_argument("--cluster-listen", default=None, help="host:port for cluster RPC")
+    ap.add_argument(
+        "--peer", action="append", default=[],
+        help="peer node as <node_id>@<host>:<port>; repeatable",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)

@@ -1,0 +1,261 @@
+"""Broadcast (scatter-gather) cluster mode.
+
+Mirrors `rmqtt-plugins/rmqtt-cluster-broadcast` (SURVEY.md §2.3): no shared
+route table — each node routes its local subscriptions; a publish is
+broadcast to every peer, each matches locally and delivers its non-shared
+subscribers, returning its shared-subscription candidates; the publishing
+node then performs the *global* shared-group choice and sends targeted
+``ForwardsTo`` (`src/shared.rs:367-560`). Session takeover kicks fan out via
+``select_ok`` (`src/lib.rs:179-200`); retained messages are broadcast on set
+and synced from peers at startup (`src/lib.rs:146-149`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from rmqtt_tpu.broker.session import DeliverItem
+from rmqtt_tpu.broker.shared import SessionRegistry
+from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.cluster import messages as M
+from rmqtt_tpu.cluster.transport import (
+    Broadcaster,
+    ClusterReplyError,
+    ClusterServer,
+    PeerClient,
+    PeerUnavailable,
+)
+from rmqtt_tpu.router.base import Id, SubRelation
+
+log = logging.getLogger("rmqtt_tpu.cluster")
+
+
+def _cands_to_wire(shared) -> list:
+    return [
+        [group, tf, [[sid.node_id, sid.client_id, M.opts_to_wire(opts), online]
+                     for sid, opts, online in cands]]
+        for (group, tf), cands in shared.items()
+    ]
+
+
+def _cands_from_wire(rows) -> Dict[Tuple[str, str], list]:
+    out: Dict[Tuple[str, str], list] = {}
+    for group, tf, cands in rows:
+        out[(group, tf)] = [
+            (Id(n, c), M.opts_from_wire(o), online) for n, c, o, online in cands
+        ]
+    return out
+
+
+class ClusterSessionRegistry(SessionRegistry):
+    """Registry whose fan-out scatter-gathers across the cluster."""
+
+    def __init__(self, ctx) -> None:
+        super().__init__(ctx)
+        self.cluster: Optional["BroadcastCluster"] = None
+
+    async def forwards(self, msg: Message) -> int:
+        cluster = self.cluster
+        if cluster is None or not cluster.peers:
+            return await super().forwards(msg)
+        if msg.target_clientid is not None:  # p2p: local first, then peers
+            if self._sessions.get(msg.target_clientid) is not None:
+                return await super().forwards(msg)
+            try:
+                await cluster.bcast.select_ok(M.FORWARDS_TO, {
+                    "msg": M.msg_to_wire(msg),
+                    "rels": [],
+                    "p2p": msg.target_clientid,
+                })
+                return 1
+            except (PeerUnavailable, ClusterReplyError):
+                return 0  # no node owns this client
+        # 1) local: deliver non-shared, collect shared candidates
+        raw = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
+        relmap, shared = raw
+        count = self._deliver_relmap(relmap, msg)
+        # 2) scatter: peers deliver their non-shared and reply candidates
+        replies = await cluster.bcast.join_all_call(
+            M.FORWARDS, {"msg": M.msg_to_wire(msg)}
+        )
+        merged: Dict[Tuple[str, str], list] = {k: list(v) for k, v in shared.items()}
+        for node_id, reply in replies:
+            if isinstance(reply, Exception):
+                continue
+            count += int(reply.get("count", 0))
+            for key, cands in _cands_from_wire(reply.get("shared", [])).items():
+                merged.setdefault(key, []).extend(cands)
+        # 3) global shared-group choice (src/shared.rs:516-560)
+        remote_targets: Dict[int, List[SubRelation]] = {}
+        for (group, tf), cands in merged.items():
+            idx = self.ctx.router._shared_choice(group, tf, cands)
+            if idx is None:
+                continue
+            sid, opts, _ = cands[idx]
+            rel = SubRelation(tf, sid, opts)
+            if sid.node_id == self.ctx.node_id:
+                count += self._deliver_local(sid.client_id, tf, opts, msg)
+            else:
+                remote_targets.setdefault(sid.node_id, []).append(rel)
+        for node_id, rels in remote_targets.items():
+            peer = cluster.peers.get(node_id)
+            if peer is None:
+                continue
+            try:
+                await peer.notify(M.FORWARDS_TO, {
+                    "msg": M.msg_to_wire(msg),
+                    "rels": [M.relation_to_wire(r) for r in rels],
+                    "p2p": None,
+                })
+                count += len(rels)
+            except PeerUnavailable:
+                log.warning("ForwardsTo to node %s failed", node_id)
+        return count
+
+    def _deliver_relmap(self, relmap, msg: Message) -> int:
+        count = 0
+        for _node, rels in relmap.items():
+            for rel in rels:
+                count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+        return count
+
+    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
+        # cross-node kick: tell peers to drop any session with this id and
+        # WAIT for their confirmation before going live, so the old copy is
+        # dead before the new session exists (broadcast-mode kick,
+        # src/lib.rs:179-200; errors are tolerated — a down peer can't hold
+        # a live session anyway)
+        if self.cluster is not None and self.cluster.peers:
+            await self.cluster.bcast.join_all_call(
+                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
+            )
+        return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
+
+
+class BroadcastCluster:
+    def __init__(
+        self,
+        ctx,
+        listen: Tuple[str, int],
+        peers: List[Tuple[int, str, int]],
+        sync_retains: bool = True,
+    ) -> None:
+        self.ctx = ctx
+        self.server = ClusterServer(listen[0], listen[1], self._on_message)
+        self.peers: Dict[int, PeerClient] = {
+            nid: PeerClient(nid, host, port) for nid, host, port in peers
+        }
+        self.bcast = Broadcaster(list(self.peers.values()))
+        self.sync_retains = sync_retains
+        assert isinstance(ctx.registry, ClusterSessionRegistry), (
+            "cluster mode needs ServerContext(registry='cluster')"
+        )
+        ctx.registry.cluster = self
+        # broadcast retained sets to peers (retain_set_broadcast analogue)
+        ctx.retain.on_set = self._on_retain_set
+        # strong refs: asyncio holds tasks weakly — an unreferenced broadcast
+        # task could be GC'd before it runs
+        self._bg_tasks: set = set()
+
+    @property
+    def bound_port(self) -> int:
+        return self.server.bound_port
+
+    async def start(self) -> None:
+        await self.server.start()
+
+    async def start_sync(self) -> None:
+        """Pull retained messages from peers (startup sync, lib.rs:146-149)."""
+        if not self.sync_retains:
+            return
+        for node_id, reply in await self.bcast.join_all_call(M.GET_RETAINS, {"filter": "#"}):
+            if isinstance(reply, Exception):
+                continue
+            for topic, mw in reply.get("retains", []):
+                msg = M.msg_from_wire(mw)
+                self.ctx.retain.set_local(topic, msg)
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        for p in self.peers.values():
+            await p.close()
+
+    # ----------------------------------------------------------- outbound
+    def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
+        async def push():
+            await self.bcast.join_all_notify(
+                M.SET_RETAIN,
+                {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
+            )
+
+        task = asyncio.get_running_loop().create_task(push())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    # ------------------------------------------------------------ inbound
+    async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
+        ctx = self.ctx
+        if mtype == M.FORWARDS:
+            msg = M.msg_from_wire(body["msg"])
+            raw = await ctx.routing.matches_raw(msg.from_id, msg.topic)
+            relmap, shared = raw
+            count = ctx.registry._deliver_relmap(relmap, msg)
+            return {"count": count, "shared": _cands_to_wire(shared)}
+        if mtype == M.FORWARDS_TO:
+            msg = M.msg_from_wire(body["msg"])
+            if body.get("p2p"):
+                target = ctx.registry.get(body["p2p"])
+                if target is None:
+                    raise ClusterReplyError("no-such-client")  # select_ok tries next peer
+                target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
+                return {"count": 1}
+            count = 0
+            for rw in body["rels"]:
+                rel = M.relation_from_wire(rw)
+                count += ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+            return {"count": count}
+        if mtype == M.KICK:
+            session = ctx.registry.get(body["client_id"])
+            if session is not None:
+                if session.state is not None:
+                    await session.state.close(kicked=True)
+                    # wait (bounded) for the old loop to unwind so the
+                    # caller's new session starts after this one is dead
+                    for _ in range(100):
+                        if not session.connected:
+                            break
+                        await asyncio.sleep(0.01)
+                # the session now lives on the caller's node; drop the local
+                # copy entirely (cross-node offline-state transfer is the
+                # raft mode's OfflineSession feature — not implemented yet)
+                await ctx.registry.terminate(session, "cluster-kick")
+                return {"kicked": True}
+            return {"kicked": False}
+        if mtype == M.GET_RETAINS:
+            filt = body.get("filter", "#")
+            items = ctx.retain.all_items() if filt == "#" else ctx.retain.matches(filt)
+            return {"retains": [[topic, M.msg_to_wire(m)] for topic, m in items]}
+        if mtype == M.SET_RETAIN:
+            mw = body.get("msg")
+            if mw is None:
+                ctx.retain.remove_local(body["topic"])
+            else:
+                ctx.retain.set_local(body["topic"], M.msg_from_wire(mw))
+            return None
+        if mtype == M.NUMBER_OF_CLIENTS:
+            return {"count": ctx.registry.connected_count()}
+        if mtype == M.NUMBER_OF_SESSIONS:
+            return {"count": ctx.registry.session_count()}
+        if mtype == M.ONLINE:
+            s = ctx.registry.get(body["client_id"])
+            return {"online": bool(s and s.connected)}
+        if mtype == M.SESSION_STATUS:
+            s = ctx.registry.get(body["client_id"])
+            if s is None:
+                return {"exists": False}
+            return {"exists": True, "online": s.connected, "subs": len(s.subscriptions)}
+        if mtype == M.PING:
+            return {"pong": True}
+        raise ValueError(f"unknown cluster message {mtype!r}")

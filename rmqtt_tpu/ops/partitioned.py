@@ -1,0 +1,349 @@
+"""Partitioned automaton: trie-style pruning flattened for the TPU.
+
+The dense matcher scans every filter row per topic; the reference's trie
+wins by pruning on the first levels (`/root/reference/rmqtt/src/trie.rs`
+DFS only descends matching branches). This module flattens exactly that
+pruning into static-shaped TPU compute:
+
+Filters are bucketed by their first two levels into *partitions*
+(NOTES.md design):
+
+- ``("#",)``      — the bare ``#`` filter;
+- ``("1", k0)``   — single-level filters (k0 = token or ``+``);
+- ``("2", k0)``   — ``<k0>/#`` (prefix length 1);
+- ``("3", k0, k1)`` — everything else, k0/k1 ∈ {token, ``+``}.
+
+A publish topic (t0, t1, …) can only match filters in ≤7 partitions:
+``#``, ``t0/#``, ``+/#``, (t0,t1), (t0,+), (+,t1), (+,+) — plus the
+single-level partitions when the topic has one level. Each partition owns
+fixed-size row *chunks* (``CHUNK`` rows) in the flat table, so churn is O(1)
+and the kernel sees a per-topic list of chunk ids: one `lax.scan` step
+gathers a [B, CHUNK] row tile per candidate chunk, applies the same level
+formula as `ops.match`, and packs words; a final word-level ``top_k``
+compacts matches exactly like the dense path. Per-topic work drops from
+O(F) to O(candidate rows) — the trie's pruning, with dense regular tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
+from rmqtt_tpu.ops.encode import HASH_TOK, PAD_TOK, PLUS_TOK, TokenDict, UNK_TOK
+
+CHUNK = 128  # rows per partition chunk (4 packed words)
+WORDS_PER_CHUNK = CHUNK // 32
+
+# partition key kinds
+_K_HASH = ("#",)
+
+
+def partition_key(levels: Sequence[str]) -> Tuple:
+    """Partition of a (stripped, validated) filter; see module docstring."""
+    f0 = levels[0]
+    if f0 == HASH:
+        return _K_HASH
+    k0 = PLUS if f0 == PLUS else f0
+    if len(levels) == 1:
+        return ("1", k0)
+    if levels[1] == HASH:
+        return ("2", k0)
+    f1 = levels[1]
+    k1 = PLUS if f1 == PLUS else f1
+    return ("3", k0, k1)
+
+
+def topic_partitions(levels: Sequence[str]) -> List[Tuple]:
+    """Candidate partitions for a publish topic (≤7)."""
+    t0 = levels[0]
+    out: List[Tuple] = [_K_HASH, ("2", t0), ("2", PLUS)]
+    if len(levels) == 1:
+        out += [("1", t0), ("1", PLUS)]
+    else:
+        t1 = levels[1]
+        out += [("3", t0, t1), ("3", t0, PLUS), ("3", PLUS, t1), ("3", PLUS, PLUS)]
+    return out
+
+
+class PartitionedTable:
+    """Flat filter-row arrays with partition-chunked allocation.
+
+    Chunk 0 is reserved empty (the padding target for per-topic chunk lists).
+    """
+
+    def __init__(self, max_levels: int = 8) -> None:
+        self.max_levels = max_levels
+        self.nchunks = 1  # chunk 0 = reserved empty
+        self._cap_chunks = 64
+        self._alloc(self._cap_chunks, max_levels)
+        self.tokens = TokenDict()
+        # partition key → list of chunk ids owned
+        self._chunks_of: Dict[Tuple, List[int]] = {}
+        # partition key → free (unused) row slots in its chunks
+        self._free_of: Dict[Tuple, List[int]] = {}
+        self._key_of_fid: Dict[int, Tuple] = {}
+        self.size = 0
+        self.version = 0
+        # per-(t0[,t1]) candidate-chunk-list caches, invalidated on mutation
+        self._cand_cache: Dict[Tuple, np.ndarray] = {}
+        self._cand_version = -1
+
+    # ------------------------------------------------------------- storage
+    def _alloc(self, cap_chunks: int, lvl: int) -> None:
+        rows = cap_chunks * CHUNK
+        self.tok = np.zeros((rows, lvl), dtype=np.int32)
+        self.flen = np.full((rows,), -1, dtype=np.int32)
+        self.prefix_len = np.zeros((rows,), dtype=np.int32)
+        self.has_hash = np.zeros((rows,), dtype=bool)
+        self.first_wild = np.zeros((rows,), dtype=bool)
+
+    def _grow(self, need_chunks: int, need_levels: int) -> None:
+        new_cap = self._cap_chunks
+        while new_cap < need_chunks:
+            new_cap *= 2
+        new_lvl = max(need_levels, self.max_levels)
+        if new_cap == self._cap_chunks and new_lvl == self.max_levels:
+            return
+        old = (self.tok, self.flen, self.prefix_len, self.has_hash, self.first_wild)
+        old_rows, old_lvl = self._cap_chunks * CHUNK, self.max_levels
+        self._cap_chunks, self.max_levels = new_cap, new_lvl
+        self._alloc(new_cap, new_lvl)
+        self.tok[:old_rows, :old_lvl] = old[0]
+        self.flen[:old_rows] = old[1]
+        self.prefix_len[:old_rows] = old[2]
+        self.has_hash[:old_rows] = old[3]
+        self.first_wild[:old_rows] = old[4]
+
+    def _new_chunk(self, key: Tuple) -> int:
+        cid = self.nchunks
+        self.nchunks += 1
+        if self.nchunks > self._cap_chunks:
+            self._grow(self.nchunks, self.max_levels)
+        self._chunks_of.setdefault(key, []).append(cid)
+        base = cid * CHUNK
+        self._free_of.setdefault(key, []).extend(range(base + CHUNK - 1, base - 1, -1))
+        return cid
+
+    # ----------------------------------------------------------------- API
+    def add(self, topic_filter: str | Sequence[str]) -> int:
+        levels = split_levels(topic_filter) if isinstance(topic_filter, str) else list(topic_filter)
+        nlev = len(levels)
+        if nlev > self.max_levels:
+            self._grow(self._cap_chunks, nlev)
+        key = partition_key(levels)
+        free = self._free_of.get(key)
+        if not free:
+            self._new_chunk(key)
+            free = self._free_of[key]
+        fid = free.pop()
+        row = self.tok[fid]
+        row[:] = PAD_TOK
+        for i, lev in enumerate(levels):
+            if lev == PLUS:
+                row[i] = PLUS_TOK
+            elif lev == HASH:
+                row[i] = HASH_TOK
+            else:
+                row[i] = self.tokens.intern(lev)
+        hh = levels[-1] == HASH
+        self.flen[fid] = nlev
+        self.prefix_len[fid] = nlev - 1 if hh else nlev
+        self.has_hash[fid] = hh
+        self.first_wild[fid] = levels[0] in (PLUS, HASH)
+        self._key_of_fid[fid] = key
+        self.size += 1
+        self.version += 1
+        return fid
+
+    def remove(self, fid: int) -> None:
+        key = self._key_of_fid.pop(fid, None)
+        if key is None:
+            raise KeyError(f"fid {fid} not active")
+        self.tok[fid, :] = PAD_TOK
+        self.flen[fid] = -1
+        self.prefix_len[fid] = 0
+        self.has_hash[fid] = False
+        self.first_wild[fid] = False
+        self._free_of[key].append(fid)
+        self.size -= 1
+        self.version += 1
+
+    # -------------------------------------------------------- topic encode
+    def encode_topics(
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+        """→ (ttok, tlen, tdollar, chunk_ids [B, NC], nc).
+
+        ``chunk_ids`` lists each topic's candidate chunks padded with the
+        reserved empty chunk 0; NC is the batch max (padded to a power of
+        two to bound recompiles).
+        """
+        batch = len(topics)
+        b = pad_batch_to or batch
+        lvl = self.max_levels
+        tlen = np.full((b,), -2, dtype=np.int32)
+        tdollar = np.zeros((b,), dtype=bool)
+        tok_rows: List[List[int]] = []
+        per_topic_chunks: List[np.ndarray] = []
+        lookup = self.tokens.lookup
+        if self._cand_version != self.version:
+            self._cand_cache.clear()
+            self._cand_version = self.version
+        cache = self._cand_cache
+        for j, topic in enumerate(topics):
+            levels = split_levels(topic) if isinstance(topic, str) else list(topic)
+            tlen[j] = len(levels)
+            tdollar[j] = bool(levels[0]) and is_metadata(levels[0])
+            row = [lookup(lev) for lev in levels[:lvl]]
+            row += [PAD_TOK] * (lvl - len(row))
+            tok_rows.append(row)
+            # candidate chunks: cached per (t0,) / (t0, t1) — topics share
+            # these heavily (the wildcard partitions are common to all)
+            ckey = (levels[0],) if len(levels) == 1 else (levels[0], levels[1])
+            cand = cache.get(ckey)
+            if cand is None:
+                chunks: List[int] = []
+                for key in topic_partitions(levels):
+                    chunks.extend(self._chunks_of.get(key, ()))
+                cand = np.asarray(chunks, dtype=np.int32)
+                cache[ckey] = cand
+            per_topic_chunks.append(cand)
+        ttok = np.zeros((b, lvl), dtype=np.int32)
+        if batch:
+            ttok[:batch] = np.asarray(tok_rows, dtype=np.int32)
+        nc = max((len(c) for c in per_topic_chunks), default=1)
+        nc = max(1, 1 << (max(1, nc) - 1).bit_length())  # pow2 bucket
+        chunk_ids = np.zeros((b, nc), dtype=np.int32)  # 0 = empty chunk
+        for j, chunks in enumerate(per_topic_chunks):
+            chunk_ids[j, : len(chunks)] = chunks
+        return ttok, tlen, tdollar, chunk_ids, nc
+
+
+def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_words: int):
+    """Gather-based partitioned match → (word_idx, word_bits, counts).
+
+    ``packed_rows`` is chunk-tiled ``[nchunks, CHUNK, L+3]`` — per-row level
+    tokens followed by (flen, prefix_len, hash|wild flags) so each scan step
+    issues ONE whole-tile gather by leading-axis index (measured ~40× faster
+    on TPU than row-granular gathers, and one big gather beats five small
+    ones — NOTES.md). Word w of topic b covers rows
+    ``chunk_ids[b, w // WPC]*CHUNK + (w % WPC)*32 .. +31`` — the host maps
+    set bits back to global fids.
+    """
+    b, nc = chunk_ids.shape
+    lvl = packed_rows.shape[-1] - 3
+    lvl_idx = jnp.arange(lvl, dtype=jnp.int32)
+    bit = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+
+    def body(_, cid):  # cid: [B]
+        g = packed_rows[cid]  # [B, CHUNK, L+3] single tile gather
+        ftok_g = g[:, :, :lvl]
+        flen_g = g[:, :, lvl]
+        pl_g = g[:, :, lvl + 1]
+        flags = g[:, :, lvl + 2]
+        hh_g = (flags & 1) != 0
+        fw_g = (flags & 2) != 0
+        eq = ftok_g == ttok[:, None, :]
+        plus = ftok_g == PLUS_TOK
+        beyond = lvl_idx[None, None, :] >= pl_g[:, :, None]
+        prefix_ok = jnp.all(eq | plus | beyond, axis=-1)  # [B, CHUNK]
+        len_ok = jnp.where(hh_g, tlen[:, None] >= pl_g, tlen[:, None] == flen_g)
+        dollar_ok = jnp.logical_not(tdollar[:, None] & fw_g)
+        m = prefix_ok & len_ok & dollar_ok
+        packed = jnp.sum(
+            m.reshape(b, WORDS_PER_CHUNK, 32).astype(jnp.uint32) * bit[None, None, :],
+            axis=-1,
+            dtype=jnp.uint32,
+        )
+        return None, packed  # [B, WPC]
+
+    _, words = lax.scan(body, None, jnp.moveaxis(chunk_ids, 0, 1))  # [NC, B, WPC]
+    words = jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
+    counts = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=1)
+    w = words.shape[1]
+    kw = min(max_words, w)
+    val = jnp.where(words != 0, jnp.int32(w) - jnp.arange(w, dtype=jnp.int32), 0)
+    _, word_idx = lax.top_k(val, kw)
+    word_bits = jnp.take_along_axis(words, word_idx, axis=1)
+    return word_idx, word_bits, counts
+
+
+_match_partitioned = jax.jit(match_partitioned_impl, static_argnames=("max_words",))
+
+
+class PartitionedMatcher:
+    """Device mirror + batched match over a ``PartitionedTable``."""
+
+    def __init__(self, table: PartitionedTable, device=None, max_words: int = 32) -> None:
+        self.table = table
+        self.device = device
+        self.max_words = max_words
+        self._dev_version = -1
+        self._dev_arrays = None
+
+    def _refresh(self):
+        t = self.table
+        if self._dev_version != t.version or self._dev_arrays is None:
+            put = (
+                functools.partial(jax.device_put, device=self.device)
+                if self.device
+                else jax.device_put
+            )
+            rows = t.nchunks * CHUNK  # upload only the active prefix
+            lvl = t.max_levels
+            packed = np.concatenate(
+                [
+                    t.tok[:rows],
+                    t.flen[:rows, None],
+                    t.prefix_len[:rows, None],
+                    (t.has_hash[:rows].astype(np.int32) | (t.first_wild[:rows] << 1))[:, None],
+                ],
+                axis=1,
+            )
+            self._dev_arrays = put(packed.reshape(-1, CHUNK, lvl + 3))
+            self._dev_version = t.version
+        return self._dev_arrays
+
+    def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
+        b = len(topics)
+        padded = 1 << (b - 1).bit_length() if (pad_to_pow2 and b > 1) else b
+        ttok, tlen, tdollar, chunk_ids, _nc = self.table.encode_topics(
+            topics, pad_batch_to=padded
+        )
+        dev = self._refresh()
+        max_words = self.max_words
+        while True:
+            wi, wb, cn = _match_partitioned(
+                dev, ttok, tlen, tdollar, chunk_ids, max_words=max_words
+            )
+            wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
+            if int(cn[:b].max(initial=0)) <= max_words:
+                break
+            max_words = 1 << (int(cn[:b].max()) - 1).bit_length()  # rare: re-run wider
+        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b)
+
+
+def _decode_batch(wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int) -> List[np.ndarray]:
+    """Vectorized (word_idx, word_bits) → per-topic fid arrays."""
+    wpc = WORDS_PER_CHUNK
+    k = wi.shape[1]
+    bitpos = np.unpackbits(
+        np.ascontiguousarray(wb).view(np.uint8).reshape(b * k, 4), axis=1, bitorder="little"
+    ).reshape(b, k, 32)
+    tj, kj, cols = np.nonzero(bitpos)
+    widx = wi[tj, kj]
+    fids = (
+        chunk_ids[tj, widx // wpc].astype(np.int64) * CHUNK
+        + (widx % wpc).astype(np.int64) * 32
+        + cols
+    )
+    order = np.lexsort((fids, tj))
+    tj, fids = tj[order], fids[order]
+    bounds = np.searchsorted(tj, np.arange(1, b))
+    return np.split(fids, bounds)

@@ -1,0 +1,114 @@
+"""$SYS topics plugin.
+
+Mirrors `rmqtt-plugins/rmqtt-sys-topic` (SURVEY.md §2.3): periodic
+``$SYS/brokers/...`` status publishes plus session/message event topics
+(client connected/disconnected/subscribed/unsubscribed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from rmqtt_tpu import __version__
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.types import Message, now
+from rmqtt_tpu.plugins import Plugin
+from rmqtt_tpu.router.base import Id
+
+
+class SysTopicPlugin(Plugin):
+    name = "rmqtt-sys-topic"
+    descr = "periodic $SYS broker status + client event topics"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        self.interval = float(self.config.get("publish_interval", 60.0))
+        self._task: Optional[asyncio.Task] = None
+        self._unhooks = []
+
+    @property
+    def _prefix(self) -> str:
+        return f"$SYS/brokers/{self.ctx.node_id}"
+
+    async def _publish(self, topic: str, payload: bytes, retain: bool = False) -> None:
+        msg = Message(
+            topic=topic, payload=payload, qos=0, retain=retain,
+            from_id=Id(self.ctx.node_id, "$SYS"),
+        )
+        if retain:
+            self.ctx.retain.set(topic, msg)
+        await self.ctx.registry.forwards(msg)
+
+    async def init(self) -> None:
+        hooks = self.ctx.hooks
+
+        async def on_connected(_ht, args, _prev):
+            ci = args[0]
+            await self._publish(
+                f"{self._prefix}/clients/{ci.id.client_id}/connected",
+                json.dumps({"clientid": ci.id.client_id, "username": ci.username,
+                            "ts": now()}).encode(),
+            )
+            return None
+
+        async def on_disconnected(_ht, args, _prev):
+            id, reason = args[0], args[1]
+            await self._publish(
+                f"{self._prefix}/clients/{id.client_id}/disconnected",
+                json.dumps({"clientid": id.client_id, "reason": reason, "ts": now()}).encode(),
+            )
+            return None
+
+        async def on_subscribed(_ht, args, _prev):
+            id, tf = args[0], args[1]
+            await self._publish(
+                f"{self._prefix}/session/{id.client_id}/subscribed",
+                json.dumps({"clientid": id.client_id, "topic": tf}).encode(),
+            )
+            return None
+
+        async def on_unsubscribed(_ht, args, _prev):
+            id, tf = args[0], args[1]
+            await self._publish(
+                f"{self._prefix}/session/{id.client_id}/unsubscribed",
+                json.dumps({"clientid": id.client_id, "topic": tf}).encode(),
+            )
+            return None
+
+        self._unhooks = [
+            hooks.register(HookType.CLIENT_CONNECTED, on_connected),
+            hooks.register(HookType.CLIENT_DISCONNECTED, on_disconnected),
+            hooks.register(HookType.SESSION_SUBSCRIBED, on_subscribed),
+            hooks.register(HookType.SESSION_UNSUBSCRIBED, on_unsubscribed),
+        ]
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> bool:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for un in self._unhooks:
+            un()
+        self._unhooks = []
+        return True
+
+    async def _loop(self) -> None:
+        while True:
+            stats = self.ctx.stats()
+            await self._publish(f"{self._prefix}/version", __version__.encode(), retain=True)
+            await self._publish(
+                f"{self._prefix}/stats", json.dumps(stats.to_json()).encode()
+            )
+            await self._publish(
+                f"{self._prefix}/metrics", json.dumps(self.ctx.metrics.to_json()).encode()
+            )
+            await asyncio.sleep(self.interval)

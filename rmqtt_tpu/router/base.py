@@ -94,12 +94,32 @@ class Router(abc.ABC):
         """Remove a subscription; True if it existed."""
 
     @abc.abstractmethod
+    def matches_raw(self, from_id: Optional[Id], topic: str):
+        """→ (non-shared SubRelationsMap, shared-group candidates).
+
+        Shared groups are left un-collapsed so cluster modes can merge
+        candidates across nodes before choosing (broadcast-mode global
+        choice, `rmqtt-cluster-broadcast/src/shared.rs:516-560`).
+        """
+
+    def matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
+        """Batched `matches_raw` — the TPU path overrides with one launch."""
+        return [self.matches_raw(fid, topic) for fid, topic in items]
+
+    def collapse(self, raw) -> SubRelationsMap:
+        """Collapse shared-group candidates with this router's strategy."""
+        from rmqtt_tpu.router.relations import collapse_shared
+
+        out, shared = raw
+        return collapse_shared(out, shared, self._shared_choice)
+
     def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
         """All deliverable relations for one publish topic."""
+        return self.collapse(self.matches_raw(from_id, topic))
 
     def matches_batch(self, items: Sequence[Tuple[Optional[Id], str]]) -> List[SubRelationsMap]:
-        """Batched `matches` — the TPU path overrides this with one kernel call."""
-        return [self.matches(fid, topic) for fid, topic in items]
+        """Batched `matches` — single kernel launch on the TPU path."""
+        return [self.collapse(raw) for raw in self.matches_batch_raw(items)]
 
     # --- admin / introspection surface (router.rs gets/query/topics) ---
     @abc.abstractmethod

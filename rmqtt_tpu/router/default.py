@@ -21,7 +21,7 @@ from rmqtt_tpu.router.base import (
     SubscriptionOptions,
     round_robin_choice_factory,
 )
-from rmqtt_tpu.router.relations import RelationsMap, expand_matches
+from rmqtt_tpu.router.relations import RelationsMap, expand_matches_raw
 
 
 class DefaultRouter(Router):
@@ -45,9 +45,9 @@ class DefaultRouter(Router):
             self._trie.remove(topic_filter, topic_filter)
         return existed
 
-    def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
+    def matches_raw(self, from_id: Optional[Id], topic: str):
         matched = [tf for _levels, vals in self._trie.matches(topic) for tf in vals]
-        return expand_matches(matched, self._relations, from_id, self._shared_choice, self._is_online)
+        return expand_matches_raw(matched, self._relations, from_id, self._is_online)
 
     def is_match(self, topic: str) -> bool:
         return self._trie.is_match(topic)

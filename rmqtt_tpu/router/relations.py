@@ -63,17 +63,25 @@ class RelationsMap:
         return self._map.items()
 
 
-def expand_matches(
+# (group, filter) → candidates [(Id, opts, online)]
+SharedCandidates = Dict[Tuple[str, str], List[Tuple[Id, SubscriptionOptions, bool]]]
+
+
+def expand_matches_raw(
     matched_filters: List[str],
     relations: RelationsMap,
     from_id: Optional[Id],
-    shared_choice: SharedChoiceFn,
     is_online: Callable[[ClientId], bool],
-) -> SubRelationsMap:
-    """Filters → SubRelationsMap with No-Local + shared-group collapse."""
+) -> Tuple[SubRelationsMap, SharedCandidates]:
+    """Filters → (non-shared relations, shared-group candidates).
+
+    Shared groups are NOT collapsed here — the cluster layer merges
+    candidates across nodes before choosing (the reference's broadcast-mode
+    global choice, `rmqtt-cluster-broadcast/src/shared.rs:516-560`);
+    single-node callers collapse immediately via `collapse_shared`.
+    """
     out: SubRelationsMap = {}
-    # (group, filter) → candidates [(Id, opts, online)]
-    shared: Dict[Tuple[str, str], List[Tuple[Id, SubscriptionOptions, bool]]] = {}
+    shared: SharedCandidates = {}
     for tf in matched_filters:
         for cid, (sid, opts) in relations.get(tf).items():
             if opts.no_local and from_id is not None and cid == from_id.client_id:
@@ -84,6 +92,16 @@ def expand_matches(
                 )
             else:
                 out.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
+    return out, shared
+
+
+def collapse_shared(
+    out: SubRelationsMap,
+    shared: SharedCandidates,
+    shared_choice: SharedChoiceFn,
+) -> SubRelationsMap:
+    """Pick one subscriber per shared group and merge into the relation map
+    (router.rs:236-255)."""
     for (group, tf), candidates in shared.items():
         idx = shared_choice(group, tf, candidates)
         if idx is None:
@@ -91,3 +109,15 @@ def expand_matches(
         sid, opts, _ = candidates[idx]
         out.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
     return out
+
+
+def expand_matches(
+    matched_filters: List[str],
+    relations: RelationsMap,
+    from_id: Optional[Id],
+    shared_choice: SharedChoiceFn,
+    is_online: Callable[[ClientId], bool],
+) -> SubRelationsMap:
+    """Filters → SubRelationsMap with No-Local + local shared-group collapse."""
+    out, shared = expand_matches_raw(matched_filters, relations, from_id, is_online)
+    return collapse_shared(out, shared, shared_choice)

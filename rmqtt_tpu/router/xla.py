@@ -25,7 +25,7 @@ from rmqtt_tpu.router.base import (
     SubscriptionOptions,
     round_robin_choice_factory,
 )
-from rmqtt_tpu.router.relations import RelationsMap, expand_matches
+from rmqtt_tpu.router.relations import RelationsMap, expand_matches_raw
 
 
 class XlaRouter(Router):
@@ -33,11 +33,20 @@ class XlaRouter(Router):
         self,
         shared_choice: Optional[SharedChoiceFn] = None,
         is_online: Callable[[ClientId], bool] = lambda cid: True,
-        table: Optional[FilterTable] = None,
+        table=None,
         device=None,
+        backend: str = "partitioned",
     ) -> None:
-        self.table = table or FilterTable()
-        self.matcher = TpuMatcher(self.table, device=device)
+        if backend == "partitioned":
+            from rmqtt_tpu.ops.partitioned import PartitionedMatcher, PartitionedTable
+
+            self.table = table or PartitionedTable()
+            self.matcher = PartitionedMatcher(self.table, device=device)
+        elif backend == "dense":
+            self.table = table or FilterTable()
+            self.matcher = TpuMatcher(self.table, device=device)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
         self._relations = RelationsMap()
         self._fid_to_filter: Dict[int, str] = {}
         self._filter_to_fid: Dict[str, int] = {}
@@ -58,18 +67,18 @@ class XlaRouter(Router):
             self.table.remove(fid)
         return existed
 
-    def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
-        return self.matches_batch([(from_id, topic)])[0]
+    def matches_raw(self, from_id: Optional[Id], topic: str):
+        return self.matches_batch_raw([(from_id, topic)])[0]
 
-    def matches_batch(self, items: Sequence[Tuple[Optional[Id], str]]) -> List[SubRelationsMap]:
+    def matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
         topics = [topic for _, topic in items]
         fid_rows = self.matcher.match(topics)
-        out: List[SubRelationsMap] = []
+        out = []
         f2f = self._fid_to_filter
         for (from_id, _topic), fids in zip(items, fid_rows):
             matched = [f2f[fid] for fid in fids.tolist()]
             out.append(
-                expand_matches(matched, self._relations, from_id, self._shared_choice, self._is_online)
+                expand_matches_raw(matched, self._relations, from_id, self._is_online)
             )
         return out
 

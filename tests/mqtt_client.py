@@ -16,6 +16,12 @@ from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
 from rmqtt_tpu.broker.codec.packets import SubOpts
 
 
+# Strong refs to live clients: asyncio holds tasks weakly, so an unbound
+# client (its read task and it form a GC cycle) would be collected mid-test,
+# silently closing the socket.
+_LIVE: set = set()
+
+
 class TestClient:
     def __init__(self, reader, writer, codec, version) -> None:
         self.reader = reader
@@ -64,6 +70,7 @@ class TestClient:
             )
         )
         await writer.drain()
+        _LIVE.add(client)
         client._task = asyncio.create_task(client._read_loop())
         client.connack = await client._wait(("connack",), timeout=5.0)
         return client
@@ -185,6 +192,7 @@ class TestClient:
         await self.close()
 
     async def close(self) -> None:
+        _LIVE.discard(self)
         if self._task is not None:
             self._task.cancel()
         try:
@@ -194,6 +202,7 @@ class TestClient:
 
     def abort(self) -> None:
         """Abrupt socket kill (no DISCONNECT) — triggers the will path."""
+        _LIVE.discard(self)
         if self._task is not None:
             self._task.cancel()
         sock = self.writer.get_extra_info("socket")

@@ -1,0 +1,170 @@
+"""Broadcast-cluster tests: multiple real brokers on localhost.
+
+The reference tests multi-node with real processes (SURVEY.md §4: the
+cluster example deployments + chaos restart). Here each node is a full
+broker + cluster server in one event loop on distinct ports — real TCP
+between nodes, real MQTT clients at the edges.
+"""
+
+import asyncio
+
+import pytest
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.cluster import wire
+from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+
+from tests.mqtt_client import TestClient
+
+
+def test_wire_roundtrip():
+    cases = [
+        None, True, False, 0, 1, -5, 2**40, 3.5, "héllo", b"\x00\xff" * 10,
+        [1, "a", None], {"k": [1, {"n": b"b"}], "e": {}},
+    ]
+    for obj in cases:
+        assert wire.loads(wire.dumps(obj)) == obj
+    with pytest.raises(ValueError):
+        wire.loads(b"\xff")
+    with pytest.raises(ValueError):
+        wire.loads(wire.dumps([1]) + b"x")
+
+
+async def make_node(node_id: int):
+    ctx = ServerContext(BrokerConfig(port=0, node_id=node_id, cluster=True))
+    broker = MqttBroker(ctx)
+    await broker.start()
+    return broker
+
+
+async def link(brokers):
+    """Start cluster servers and fully mesh the nodes."""
+    clusters = []
+    for b in brokers:
+        c = BroadcastCluster(b.ctx, ("127.0.0.1", 0), [])
+        await c.start()
+        clusters.append(c)
+    for i, c in enumerate(clusters):
+        for j, other in enumerate(clusters):
+            if i == j:
+                continue
+            from rmqtt_tpu.cluster.transport import PeerClient
+
+            nid = brokers[j].ctx.node_id
+            c.peers[nid] = PeerClient(nid, "127.0.0.1", other.bound_port)
+        c.bcast.peers = list(c.peers.values())
+    return clusters
+
+
+def cluster_test(n_nodes):
+    def deco(fn):
+        def wrapper():
+            async def run():
+                brokers = [await make_node(i + 1) for i in range(n_nodes)]
+                clusters = await link(brokers)
+                try:
+                    await asyncio.wait_for(fn(brokers, clusters), timeout=30.0)
+                finally:
+                    for c in clusters:
+                        await c.stop()
+                    for b in brokers:
+                        await b.stop()
+
+            asyncio.run(run())
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return deco
+
+
+@cluster_test(2)
+async def test_cross_node_pubsub(brokers, clusters):
+    b1, b2 = brokers
+    sub = await TestClient.connect(b1.port, "sub-on-1")
+    await sub.subscribe("cross/#", qos=1)
+    pub = await TestClient.connect(b2.port, "pub-on-2")
+    await pub.publish("cross/topic", b"over-the-wire", qos=1)
+    p = await sub.recv()
+    assert p.topic == "cross/topic" and p.payload == b"over-the-wire"
+
+
+@cluster_test(2)
+async def test_cross_node_kick(brokers, clusters):
+    b1, b2 = brokers
+    c1 = await TestClient.connect(b1.port, "roamer", version=pk.V5)
+    await c1.subscribe("r/t")
+    c2 = await TestClient.connect(b2.port, "roamer", version=pk.V5)
+    await asyncio.wait_for(c1.closed.wait(), 5.0)
+    await c2.ping()  # new session on node 2 fully works
+
+
+@cluster_test(2)
+async def test_retain_sync_on_set_and_startup(brokers, clusters):
+    b1, b2 = brokers
+    pub = await TestClient.connect(b1.port, "pub-ret")
+    await pub.publish("synced/t", b"keepme", retain=True, qos=1)
+    await asyncio.sleep(0.2)  # broadcast propagation
+    # node 2 has the retained copy locally
+    assert b2.ctx.retain.get("synced/t") is not None
+    late = await TestClient.connect(b2.port, "late")
+    await late.subscribe("synced/#")
+    p = await late.recv()
+    assert p.payload == b"keepme" and p.retain
+    # startup sync: a fresh node pulls existing retains
+    b3 = await make_node(3)
+    c3 = BroadcastCluster(b3.ctx, ("127.0.0.1", 0), [])
+    await c3.start()
+    from rmqtt_tpu.cluster.transport import PeerClient
+
+    c3.peers[1] = PeerClient(1, "127.0.0.1", clusters[0].bound_port)
+    c3.bcast.peers = list(c3.peers.values())
+    await c3.start_sync()
+    assert b3.ctx.retain.get("synced/t") is not None
+    await c3.stop()
+    await b3.stop()
+
+
+@cluster_test(3)
+async def test_shared_subscription_global_exactly_once(brokers, clusters):
+    b1, b2, b3 = brokers
+    w1 = await TestClient.connect(b1.port, "w1", version=pk.V5)
+    w2 = await TestClient.connect(b2.port, "w2", version=pk.V5)
+    await w1.subscribe("$share/g/work/#", qos=1)
+    await w2.subscribe("$share/g/work/#", qos=1)
+    pub = await TestClient.connect(b3.port, "pub3")
+    n = 10
+    for i in range(n):
+        await pub.publish("work/item", str(i).encode(), qos=1)
+    await asyncio.sleep(0.5)
+    total = w1.publishes.qsize() + w2.publishes.qsize()
+    assert total == n  # exactly one delivery per message across the cluster
+    assert w1.publishes.qsize() > 0 and w2.publishes.qsize() > 0
+
+
+@cluster_test(2)
+async def test_node_counters(brokers, clusters):
+    b1, b2 = brokers
+    await TestClient.connect(b1.port, "c1")
+    await TestClient.connect(b2.port, "c2a")
+    await TestClient.connect(b2.port, "c2b")
+    from rmqtt_tpu.cluster import messages as M
+
+    replies = await clusters[0].bcast.join_all_call(M.NUMBER_OF_CLIENTS)
+    counts = {nid: r["count"] for nid, r in replies if not isinstance(r, Exception)}
+    assert counts == {2: 2}
+
+
+@cluster_test(2)
+async def test_peer_down_does_not_break_local(brokers, clusters):
+    b1, b2 = brokers
+    await clusters[1].stop()
+    await brokers[1].stop()
+    sub = await TestClient.connect(b1.port, "local-sub")
+    await sub.subscribe("l/t", qos=1)
+    pub = await TestClient.connect(b1.port, "local-pub")
+    await pub.publish("l/t", b"still-works", qos=1)
+    p = await sub.recv()
+    assert p.payload == b"still-works"

@@ -1,0 +1,254 @@
+"""HTTP admin API + plugin tests (real sockets, real broker)."""
+
+import asyncio
+import json
+
+import pytest
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.http_api import HttpApi
+from rmqtt_tpu.broker.server import MqttBroker
+
+from tests.mqtt_client import TestClient
+
+
+async def http_get(port, path):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await w.drain()
+    status = (await r.readline()).split()[1]
+    headers = {}
+    while True:
+        line = await r.readline()
+        if line in (b"\r\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        headers[k.lower()] = v.strip()
+    body = await r.readexactly(int(headers["content-length"]))
+    w.close()
+    return int(status), body
+
+
+async def http_post(port, path, obj):
+    payload = json.dumps(obj).encode()
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.write(
+        f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Length: {len(payload)}\r\n\r\n".encode()
+        + payload
+    )
+    await w.drain()
+    status = (await r.readline()).split()[1]
+    headers = {}
+    while True:
+        line = await r.readline()
+        if line in (b"\r\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        headers[k.lower()] = v.strip()
+    body = await r.readexactly(int(headers["content-length"]))
+    w.close()
+    return int(status), json.loads(body)
+
+
+def api_test(fn, plugins=None, **cfg):
+    def wrapper():
+        async def run():
+            b = MqttBroker(ServerContext(BrokerConfig(port=0, **cfg)))
+            if plugins:
+                for factory in plugins:
+                    b.ctx.plugins.register(factory(b.ctx))
+            api = HttpApi(b.ctx, port=0)
+            await b.start()
+            await api.start()
+            try:
+                await asyncio.wait_for(fn(b, api), timeout=30.0)
+            finally:
+                await api.stop()
+                await b.stop()
+
+        asyncio.run(run())
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+@api_test
+async def test_api_surface(broker, api):
+    c = await TestClient.connect(broker.port, "api-client", version=pk.V5)
+    await c.subscribe("api/+", qos=1)
+
+    status, body = await http_get(api.bound_port, "/api/v1/brokers")
+    assert status == 200 and json.loads(body)[0]["node_id"] == 1
+    status, body = await http_get(api.bound_port, "/api/v1/nodes")
+    assert json.loads(body)[0]["connections"] == 1
+    status, body = await http_get(api.bound_port, "/api/v1/clients")
+    clients = json.loads(body)
+    assert clients[0]["clientid"] == "api-client" and clients[0]["connected"]
+    status, body = await http_get(api.bound_port, "/api/v1/clients/api-client")
+    assert json.loads(body)["subscriptions"] == 1
+    status, body = await http_get(api.bound_port, "/api/v1/subscriptions")
+    assert json.loads(body)[0]["topic_filter"] == "api/+"
+    status, body = await http_get(api.bound_port, "/api/v1/stats")
+    assert json.loads(body)["stats"]["connections"] == 1
+    status, body = await http_get(api.bound_port, "/api/v1/metrics")
+    assert "connections.established" in json.loads(body)["metrics"]
+    status, body = await http_get(api.bound_port, "/api/v1/health")
+    assert json.loads(body)["status"] == "ok"
+    status, body = await http_get(api.bound_port, "/metrics/prometheus")
+    assert b"rmqtt_connections" in body
+    status, _ = await http_get(api.bound_port, "/api/v1/nope")
+    assert status == 404
+
+
+@api_test
+async def test_api_publish_and_kick(broker, api):
+    c = await TestClient.connect(broker.port, "kickme", version=pk.V5)
+    await c.subscribe("news/#", qos=1)
+    status, reply = await http_post(
+        api.bound_port, "/api/v1/mqtt/publish",
+        {"topic": "news/today", "payload": "hello", "qos": 1},
+    )
+    assert status == 200 and reply["delivered_to"] == 1
+    p = await c.recv()
+    assert p.payload == b"hello"
+    # management kick
+    r, w = await asyncio.open_connection("127.0.0.1", api.bound_port)
+    w.write(b"DELETE /api/v1/clients/kickme HTTP/1.1\r\nHost: x\r\n\r\n")
+    await w.drain()
+    status_line = await r.readline()
+    assert b"200" in status_line
+    await asyncio.wait_for(c.closed.wait(), 3.0)
+
+
+def _sys_topic(ctx):
+    from rmqtt_tpu.plugins.sys_topic import SysTopicPlugin
+
+    return SysTopicPlugin(ctx, {"publish_interval": 0.3})
+
+
+def test_sys_topic_plugin():
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(_sys_topic(b.ctx))
+        api = HttpApi(b.ctx, port=0)
+        await b.start()
+        await api.start()
+        try:
+            c = await TestClient.connect(b.port, "syswatcher")
+            await c.subscribe("$SYS/#", qos=0)
+            seen = set()
+            for _ in range(8):
+                p = await c.recv(timeout=3.0)
+                seen.add(p.topic.rsplit("/", 1)[-1])
+                if {"stats", "version"} <= seen:
+                    break
+            assert {"stats", "version"} <= seen
+            status, body = await http_get(api.bound_port, "/api/v1/plugins")
+            plugs = json.loads(body)
+            assert plugs[0]["name"] == "rmqtt-sys-topic" and plugs[0]["active"]
+        finally:
+            await api.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_topic_rewrite_plugin():
+    async def run():
+        from rmqtt_tpu.plugins.topic_rewrite import RewriteRule, TopicRewritePlugin
+
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(
+            TopicRewritePlugin(
+                b.ctx,
+                {"rules": [RewriteRule("old/#", "new/%c", action="publish")]},
+            )
+        )
+        await b.start()
+        try:
+            sub = await TestClient.connect(b.port, "rw-sub")
+            await sub.subscribe("new/#", qos=1)
+            pub = await TestClient.connect(b.port, "rw-pub")
+            await pub.publish("old/x", b"moved", qos=1)
+            p = await sub.recv()
+            assert p.topic == "new/rw-pub" and p.payload == b"moved"
+        finally:
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_auto_subscription_plugin():
+    async def run():
+        from rmqtt_tpu.plugins.auto_subscription import AutoSubscriptionPlugin
+
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(
+            AutoSubscriptionPlugin(b.ctx, {"subscribes": [["inbox/%c", 1]]})
+        )
+        await b.start()
+        try:
+            c = await TestClient.connect(b.port, "auto-c")
+            await asyncio.sleep(0.1)
+            pub = await TestClient.connect(b.port, "auto-pub")
+            await pub.publish("inbox/auto-c", b"for-you", qos=1)
+            p = await c.recv()
+            assert p.payload == b"for-you"
+        finally:
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_p2p_plugin():
+    async def run():
+        from rmqtt_tpu.plugins.p2p import P2pPlugin
+
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(P2pPlugin(b.ctx))
+        await b.start()
+        try:
+            alice = await TestClient.connect(b.port, "alice")
+            bob = await TestClient.connect(b.port, "bob")
+            # no subscription needed: p2p targets the client directly
+            await alice.publish("$p2p/bob/chat", b"hi bob", qos=1)
+            p = await bob.recv()
+            assert p.topic == "chat" and p.payload == b"hi bob"
+        finally:
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_shared_sub_strategies():
+    from rmqtt_tpu.plugins.shared_sub import make_strategy
+    from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+    cands = [
+        (Id(1, "a"), SubscriptionOptions(), True),
+        (Id(1, "b"), SubscriptionOptions(), True),
+        (Id(2, "c"), SubscriptionOptions(), True),
+    ]
+    for name in ("random", "round_robin", "round_robin_per_group", "sticky",
+                 "local", "hash_clientid", "hash_topic"):
+        choice = make_strategy(name, node_id=1, seed=7)
+        picks = {choice("g", "t/#", cands) for _ in range(12)}
+        assert picks <= {0, 1, 2} and picks, name
+        if name == "sticky":
+            assert len(picks) == 1
+        if name == "local":
+            assert all(cands[i][0].node_id == 1 for i in picks)
+        if name in ("hash_clientid", "hash_topic"):
+            assert len(picks) == 1  # deterministic
+    # round_robin_per_group cycles
+    choice = make_strategy("round_robin_per_group")
+    seq = [choice("g", "t/#", cands) for _ in range(6)]
+    assert seq == [0, 1, 2, 0, 1, 2]
+    # offline members are skipped
+    cands2 = [
+        (Id(1, "a"), SubscriptionOptions(), False),
+        (Id(1, "b"), SubscriptionOptions(), True),
+    ]
+    choice = make_strategy("random", seed=3)
+    assert all(choice("g", "t", cands2) == 1 for _ in range(8))
