@@ -176,6 +176,39 @@ def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8):
     }
 
 
+def build_native_trie(filters):
+    """C++ trie (runtime/topics.cc) — the honest native CPU baseline."""
+    from rmqtt_tpu import runtime
+    from rmqtt_tpu.core.topic import parse_shared
+
+    if not runtime.available():
+        return None
+    t0 = time.perf_counter()
+    trie = runtime.NativeTrie()
+    for i, f in enumerate(filters):
+        _, stripped = parse_shared(f)
+        trie.add(stripped, i)
+    log(f"  native trie build: {time.perf_counter() - t0:.2f}s")
+    return trie
+
+
+def measure_cpu_native(trie, topics, sample, time_budget_s=20.0):
+    sub = topics[:sample]
+    t0 = time.perf_counter()
+    routes = 0
+    done = 0
+    step = 512
+    for i in range(0, len(sub), step):
+        rows = trie.match_batch(sub[i : i + step])
+        routes += sum(len(r) for r in rows)
+        done += len(rows)
+        if time.perf_counter() - t0 > time_budget_s:
+            break
+    total = time.perf_counter() - t0
+    return {"topics_per_sec": done / total, "routes_per_sec": routes / total,
+            "topics": done, "routes": routes}
+
+
 def measure_cpu(tree, topics, sample, time_budget_s=20.0):
     """CPU trie matches/sec over a subsample of the same topic stream."""
     sub = topics[:sample]
@@ -218,6 +251,9 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
     log(f"[{name}] {len(filters)} subs, {len(topics)} publish topics")
     tree = build_cpu_tree(filters)
     cpu = measure_cpu(tree, topics, cpu_sample)
+    native = build_native_trie(filters)
+    cpu_native = measure_cpu_native(native, topics, cpu_sample * 4) if native else None
+    del native  # free the C++ trie before the big device-table builds
     variants = {}
     for kind in ("partitioned", "dense"):
         table, fids = build_tpu_table(filters, kind)
@@ -228,21 +264,26 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
         del table, fids
     best_kind = max(("partitioned", "dense"), key=lambda k: variants[k]["topics_per_sec"])
     tpu = variants[best_kind]
+    # the honest baseline is the native (C++) trie when the toolchain exists
+    baseline = cpu_native or cpu
     res = {
         "name": name,
         "tpu": tpu,
         "tpu_backend": best_kind,
         "variants": variants,
         "cpu": cpu,
-        "speedup": tpu["topics_per_sec"] / cpu["topics_per_sec"],
+        "cpu_native": cpu_native,
+        "baseline_kind": "cpu_native" if cpu_native else "cpu_python",
+        "speedup": tpu["topics_per_sec"] / baseline["topics_per_sec"],
     }
     if "retained" in variants:
         res["retained"] = variants.pop("retained")
+    nat = f" native {cpu_native['topics_per_sec']:.0f}" if cpu_native else ""
     log(
         f"[{name}] TPU[{best_kind}] {tpu['topics_per_sec']:.0f} topics/s "
         f"({tpu['routes_per_sec']:.0f} routes/s, p50 {tpu['p50_ms']:.1f}ms "
-        f"p99 {tpu['p99_ms']:.1f}ms) | CPU {cpu['topics_per_sec']:.0f} topics/s "
-        f"| speedup {res['speedup']:.2f}x"
+        f"p99 {tpu['p99_ms']:.1f}ms) | CPU {cpu['topics_per_sec']:.0f}{nat} topics/s "
+        f"| speedup {res['speedup']:.2f}x vs {res['baseline_kind']}"
     )
     return res
 
@@ -376,10 +417,15 @@ def main():
                 "routes_per_sec": round(r["tpu"]["routes_per_sec"], 1),
                 "p99_ms": round(r["tpu"]["p99_ms"], 2),
                 "platform": platform,
+                "baseline": r["baseline_kind"],
                 "configs": {
                     k: {
                         "tpu_topics_per_sec": round(v["tpu"]["topics_per_sec"], 1),
+                        "tpu_backend": v["tpu_backend"],
                         "cpu_topics_per_sec": round(v["cpu"]["topics_per_sec"], 1),
+                        "cpu_native_topics_per_sec": (
+                            round(v["cpu_native"]["topics_per_sec"], 1) if v["cpu_native"] else None
+                        ),
                         "speedup": round(v["speedup"], 2),
                         "p99_ms": round(v["tpu"]["p99_ms"], 2),
                     }

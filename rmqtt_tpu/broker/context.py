@@ -64,11 +64,14 @@ class ServerContext:
             online = lambda cid: (
                 self.registry.get(cid) is not None and self.registry.get(cid).connected
             )
-            router = (
-                XlaRouter(is_online=online)
-                if self.cfg.router == "xla"
-                else DefaultRouter(is_online=online)
-            )
+            if self.cfg.router == "xla":
+                router = XlaRouter(is_online=online)
+            elif self.cfg.router == "native":
+                from rmqtt_tpu.router.native import NativeRouter
+
+                router = NativeRouter(is_online=online)
+            else:
+                router = DefaultRouter(is_online=online)
         self.router = router
         self.routing = RoutingService(
             router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms

@@ -208,32 +208,48 @@ class MqttBroker:
 
 
 async def _amain(args) -> None:
-    cfg = BrokerConfig(
-        host=args.host,
-        port=args.port,
-        node_id=args.node_id,
-        router=args.router,
-        cluster=bool(args.cluster_listen),
-    )
-    broker = MqttBroker(ServerContext(cfg))
+    from rmqtt_tpu import conf
+
+    # CLI flags become the highest config layer (file < env < cli); only
+    # explicitly-passed flags override (argparse defaults are None).
+    cli: dict = {}
+    if args.host is not None:
+        cli.setdefault("listener", {})["host"] = args.host
+    if args.port is not None:
+        cli.setdefault("listener", {})["port"] = args.port
+    if args.node_id is not None:
+        cli.setdefault("node", {})["id"] = args.node_id
+    if args.router is not None:
+        cli.setdefault("node", {})["router"] = args.router
+    if args.cluster_listen is not None:
+        cli.setdefault("cluster", {})["listen"] = args.cluster_listen
+    if args.peer:
+        # "<node_id>@<host>:<port>" (reference NodeAddr format,
+        # rmqtt-utils/src/lib.rs:121); CLI peers replace file peers
+        cli.setdefault("cluster", {})["peers"] = list(args.peer)
+    settings = conf.load(args.config, cli=cli)
+    broker = MqttBroker(ServerContext(settings.broker))
+    conf.instantiate_plugins(broker.ctx, settings)
     cluster = None
-    if args.cluster_listen:
+    if settings.cluster_listen:
         from rmqtt_tpu.cluster.broadcast import BroadcastCluster
 
-        chost, cport = args.cluster_listen.rsplit(":", 1)
-        peers = []
-        for spec in args.peer or []:
-            # "<node_id>@<host>:<port>" (reference NodeAddr format,
-            # rmqtt-utils/src/lib.rs:121)
-            nid, addr = spec.split("@", 1)
-            phost, pport = addr.rsplit(":", 1)
-            peers.append((int(nid), phost, int(pport)))
-        cluster = BroadcastCluster(broker.ctx, (chost, int(cport)), peers)
+        cluster = BroadcastCluster(broker.ctx, settings.cluster_listen, settings.peers)
         await cluster.start()
+    api = None
+    if settings.http_api:
+        from rmqtt_tpu.broker.http_api import HttpApi
+
+        api = HttpApi(broker.ctx, **settings.http_api)
     await broker.start()
+    if api is not None:
+        await api.start()
     if cluster is not None:
         await cluster.start_sync()
-        log.info("cluster node %s listening on %s", args.node_id, args.cluster_listen)
+        log.info(
+            "cluster node %s listening on %s", settings.broker.node_id,
+            settings.cluster_listen,
+        )
     async with broker._server:
         await broker._server.serve_forever()
 
@@ -242,10 +258,11 @@ def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="rmqtt_tpu broker")
-    ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=1883)
-    ap.add_argument("--node-id", type=int, default=1)
-    ap.add_argument("--router", choices=["trie", "xla"], default="trie")
+    ap.add_argument("--config", default=None, help="TOML settings file (rmqtt.toml)")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--node-id", type=int, default=None)
+    ap.add_argument("--router", choices=["trie", "native", "xla"], default=None)
     ap.add_argument("--cluster-listen", default=None, help="host:port for cluster RPC")
     ap.add_argument(
         "--peer", action="append", default=[],

@@ -1,0 +1,182 @@
+"""Layered configuration: TOML file + environment overrides + CLI merge.
+
+Mirrors `rmqtt-conf` (`/root/reference/rmqtt-conf/src/lib.rs:42-145`):
+a TOML settings file (sections: node / listener / mqtt / retain / cluster /
+plugins), ``RMQTT_``-prefixed environment overrides with ``__`` section
+separators and list support (reference env override w/ list-keys), and
+command-line arguments merged last (options.rs). Per-plugin config lives
+under ``[plugins.<name>]`` (the reference uses one TOML per plugin in
+``plugins.dir``; a single file with sections is the same surface).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Tuple
+
+from rmqtt_tpu.broker.context import BrokerConfig
+from rmqtt_tpu.broker.fitter import FitterConfig
+
+ENV_PREFIX = "RMQTT_"
+
+
+def _env_overrides(environ=None) -> Dict[str, Any]:
+    """``RMQTT_MQTT__MAX_QOS=1`` → {"mqtt": {"max_qos": 1}}. Values parse as
+    TOML scalars (ints/bools/strings); comma lists become lists."""
+    environ = environ if environ is not None else os.environ
+    out: Dict[str, Any] = {}
+    for key, raw in environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        path = key[len(ENV_PREFIX) :].lower().split("__")
+        value: Any
+        low = raw.strip()
+        if "," in low:
+            value = [_scalar(x.strip()) for x in low.split(",") if x.strip()]
+        else:
+            value = _scalar(low)
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = value
+    return out
+
+
+def _scalar(s: str) -> Any:
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+@dataclass
+class Settings:
+    """The resolved configuration tree."""
+
+    broker: BrokerConfig
+    http_api: Optional[Dict[str, Any]]  # {"host":..., "port":...} or None
+    cluster_listen: Optional[Tuple[str, int]]
+    peers: List[Tuple[int, str, int]]
+    plugins: Dict[str, Dict[str, Any]]  # name → config
+    default_startups: List[str]
+    raw: Dict[str, Any]
+
+
+def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
+         environ=None) -> Settings:
+    """file (lowest) ← env ← cli (highest), like Settings::init + merge."""
+    tree: Dict[str, Any] = {}
+    if path:
+        with open(path, "rb") as f:
+            tree = tomllib.load(f)
+    tree = _deep_merge(tree, _env_overrides(environ))
+    if cli:
+        tree = _deep_merge(tree, {k: v for k, v in cli.items() if v is not None})
+
+    node = tree.get("node", {})
+    listener = tree.get("listener", {})
+    mqtt = tree.get("mqtt", {})
+    retain = tree.get("retain", {})
+    cluster = tree.get("cluster", {})
+
+    fitter_fields = {f.name for f in fields(FitterConfig)}
+    fitter = FitterConfig(**{k: v for k, v in mqtt.items() if k in fitter_fields})
+    broker_kwargs: Dict[str, Any] = {
+        "host": listener.get("host", "0.0.0.0"),
+        "port": int(listener.get("port", 1883)),
+        "node_id": int(node.get("id", 1)),
+        "router": node.get("router", "trie"),
+        "fitter": fitter,
+    }
+    broker_fields = {f.name for f in fields(BrokerConfig)}
+    for k, v in {**mqtt, **retain}.items():
+        if k in broker_fields:
+            broker_kwargs[k] = v
+    if retain:
+        if "enable" in retain:
+            broker_kwargs["retain_enable"] = bool(retain["enable"])
+        if "max_retained" in retain:
+            broker_kwargs["retain_max"] = int(retain["max_retained"])
+
+    cluster_listen = None
+    peers: List[Tuple[int, str, int]] = []
+    if cluster.get("listen"):
+        host, _, port = str(cluster["listen"]).rpartition(":")
+        cluster_listen = (host or "0.0.0.0", int(port))
+        broker_kwargs["cluster"] = True
+        for spec in cluster.get("peers", []):
+            nid, _, addr = str(spec).partition("@")
+            phost, _, pport = addr.rpartition(":")
+            peers.append((int(nid), phost, int(pport)))
+
+    http_cfg = tree.get("http_api")
+    http_api = None
+    if http_cfg and http_cfg.get("enable", True):
+        http_api = {"host": http_cfg.get("host", "127.0.0.1"),
+                    "port": int(http_cfg.get("port", 6060))}
+
+    plugins_tree = tree.get("plugins", {})
+    default_startups = list(plugins_tree.get("default_startups", []))
+    plugin_cfgs = {k: v for k, v in plugins_tree.items() if isinstance(v, dict)}
+
+    return Settings(
+        broker=BrokerConfig(**broker_kwargs),
+        http_api=http_api,
+        cluster_listen=cluster_listen,
+        peers=peers,
+        plugins=plugin_cfgs,
+        default_startups=default_startups,
+        raw=tree,
+    )
+
+
+# registry of loadable plugins: name → import path of the Plugin class
+PLUGIN_REGISTRY: Dict[str, str] = {
+    "rmqtt-sys-topic": "rmqtt_tpu.plugins.sys_topic:SysTopicPlugin",
+    "rmqtt-topic-rewrite": "rmqtt_tpu.plugins.topic_rewrite:TopicRewritePlugin",
+    "rmqtt-auto-subscription": "rmqtt_tpu.plugins.auto_subscription:AutoSubscriptionPlugin",
+    "rmqtt-counter": "rmqtt_tpu.plugins.counter:CounterPlugin",
+    "rmqtt-shared-subscription": "rmqtt_tpu.plugins.shared_sub:SharedSubscriptionPlugin",
+    "rmqtt-p2p-messaging": "rmqtt_tpu.plugins.p2p:P2pPlugin",
+    "rmqtt-acl": "rmqtt_tpu.plugins.acl_file:AclFilePlugin",
+    "rmqtt-web-hook": "rmqtt_tpu.plugins.web_hook:WebHookPlugin",
+    "rmqtt-auth-http": "rmqtt_tpu.plugins.auth_http:AuthHttpPlugin",
+    "rmqtt-auth-jwt": "rmqtt_tpu.plugins.auth_jwt:AuthJwtPlugin",
+    "rmqtt-session-storage": "rmqtt_tpu.plugins.session_storage:SessionStoragePlugin",
+    "rmqtt-message-storage": "rmqtt_tpu.plugins.message_storage:MessageStoragePlugin",
+    "rmqtt-retainer": "rmqtt_tpu.plugins.retainer:RetainerPlugin",
+    "rmqtt-bridge-ingress-mqtt": "rmqtt_tpu.plugins.bridge_mqtt:BridgeIngressMqttPlugin",
+    "rmqtt-bridge-egress-mqtt": "rmqtt_tpu.plugins.bridge_mqtt:BridgeEgressMqttPlugin",
+}
+
+
+def instantiate_plugins(ctx, settings: Settings) -> None:
+    """Register configured plugins on the context's PluginManager."""
+    import importlib
+
+    for name in settings.default_startups:
+        spec = PLUGIN_REGISTRY.get(name)
+        if spec is None:
+            raise ValueError(f"unknown plugin {name!r}")
+        mod_name, _, cls_name = spec.partition(":")
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        ctx.plugins.register(cls(ctx, settings.plugins.get(name, {})))

@@ -1,0 +1,127 @@
+"""JWT authentication plugin.
+
+Mirrors `rmqtt-plugins/rmqtt-auth-jwt`: the client's password carries a JWT;
+HS256/HS384/HS512 are verified with the configured secret (stdlib hmac —
+RSA/ES validation needs an asymmetric-crypto dependency this image doesn't
+ship; gate on config). Claims honored: ``exp`` (reject expired), optional
+``%c``/``%u`` matching claims, ``superuser``, and ``acl`` pub/sub filter
+lists enforced on the ACL hooks.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Dict, Optional
+
+from rmqtt_tpu.broker.hooks import HookResult, HookType
+from rmqtt_tpu.core.topic import match_filter
+from rmqtt_tpu.plugins import Plugin
+
+_ALGS = {"HS256": hashlib.sha256, "HS384": hashlib.sha384, "HS512": hashlib.sha512}
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def verify_hs_jwt(token: str, secret: bytes) -> Optional[dict]:
+    """→ claims dict, or None if invalid/expired."""
+    try:
+        head_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(head_b64))
+        digest = _ALGS.get(header.get("alg", ""))
+        if digest is None:
+            return None
+        expect = hmac.new(secret, f"{head_b64}.{payload_b64}".encode(), digest).digest()
+        if not hmac.compare_digest(expect, _b64url_decode(sig_b64)):
+            return None
+        claims = json.loads(_b64url_decode(payload_b64))
+    except (ValueError, KeyError):
+        return None
+    exp = claims.get("exp")
+    if exp is not None and float(exp) <= time.time():
+        return None
+    return claims
+
+
+class AuthJwtPlugin(Plugin):
+    name = "rmqtt-auth-jwt"
+    descr = "JWT (HMAC) authentication + claim-based ACL"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        secret = self.config.get("secret", "")
+        self.secret = secret.encode() if isinstance(secret, str) else bytes(secret)
+        self.from_field = self.config.get("from", "password")  # password | username
+        self._claims: Dict[str, dict] = {}
+        self._unhooks = []
+
+    async def init(self) -> None:
+        hooks = self.ctx.hooks
+
+        async def authenticate(_ht, args, prev):
+            ci = args[0]
+            token = (
+                (ci.password or b"").decode("utf-8", "replace")
+                if self.from_field == "password"
+                else (ci.username or "")
+            )
+            if not token:
+                return None  # not a JWT client; fall through
+            claims = verify_hs_jwt(token, self.secret)
+            if claims is None:
+                return HookResult(proceed=False, value=False)
+            # optional identity-claim checks (reference %c/%u placeholders)
+            if "clientid" in claims and claims["clientid"] != ci.id.client_id:
+                return HookResult(proceed=False, value=False)
+            if "username" in claims and claims["username"] != (ci.username or ""):
+                return HookResult(proceed=False, value=False)
+            self._claims[ci.id.client_id] = claims
+            return HookResult(proceed=False, value=True)
+
+        async def pub_acl(_ht, args, prev):
+            claims = self._claims.get(args[0].client_id)
+            if claims is None:
+                return None
+            if claims.get("superuser"):
+                return HookResult(proceed=False, value=True)
+            acl = claims.get("acl")
+            if not acl:
+                return None
+            allowed = acl.get("pub", [])
+            ok = any(match_filter(f, args[1].topic) for f in allowed)
+            return HookResult(proceed=False, value=ok)
+
+        async def sub_acl(_ht, args, prev):
+            claims = self._claims.get(args[0].client_id)
+            if claims is None:
+                return None
+            if claims.get("superuser"):
+                return HookResult(proceed=False, value=True)
+            acl = claims.get("acl")
+            if not acl:
+                return None
+            allowed = acl.get("sub", [])
+            ok = args[1] in allowed or any(match_filter(f, args[1]) for f in allowed)
+            return HookResult(proceed=False, value=ok)
+
+        async def terminated(_ht, args, _prev):
+            self._claims.pop(args[0].client_id, None)
+            return None
+
+        self._unhooks = [
+            hooks.register(HookType.CLIENT_AUTHENTICATE, authenticate, priority=60),
+            hooks.register(HookType.MESSAGE_PUBLISH_CHECK_ACL, pub_acl, priority=60),
+            hooks.register(HookType.CLIENT_SUBSCRIBE_CHECK_ACL, sub_acl, priority=60),
+            hooks.register(HookType.SESSION_TERMINATED, terminated),
+        ]
+
+    async def stop(self) -> bool:
+        for un in self._unhooks:
+            un()
+        self._unhooks = []
+        return True

@@ -1,0 +1,89 @@
+"""Stored-message plugin (the `MessageManager` implementation).
+
+Mirrors `rmqtt-plugins/rmqtt-message-storage` + the core ``MessageManager``
+trait (`rmqtt/src/message.rs:61-147`): published messages are stored with an
+expiry; when a client subscribes, stored messages matching the new filter
+are replayed unless already forwarded to that client (``mark_forwarded``,
+used by `rmqtt/src/shared.rs:751-760` to prevent redelivery).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Optional
+
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.session import DeliverItem
+from rmqtt_tpu.cluster.messages import msg_from_wire, msg_to_wire
+from rmqtt_tpu.core.topic import match_filter, parse_shared
+from rmqtt_tpu.plugins import Plugin
+from rmqtt_tpu.storage.sqlite import SqliteStore
+
+NS_MSG = "msg"
+NS_FWD = "msg_fwd"
+
+
+class MessageStoragePlugin(Plugin):
+    name = "rmqtt-message-storage"
+    descr = "store published messages; replay to new subscribers (sqlite)"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        self.store = SqliteStore(self.config.get("path", ":memory:"))
+        self.default_expiry = float(self.config.get("expiry", 300.0))
+        self.max_stored = int(self.config.get("max_stored", 100_000))
+        self._msg_id = itertools.count(int(time.time() * 1000))
+        self._unhooks = []
+
+    async def init(self) -> None:
+        hooks = self.ctx.hooks
+
+        async def on_publish(_ht, args, prev):
+            msg = prev if prev is not None else args[1]
+            if msg.topic.startswith("$"):
+                return None
+            if self.store.count(NS_MSG) >= self.max_stored:
+                return None
+            ttl = msg.expiry_interval or self.default_expiry
+            self.store.put(NS_MSG, str(next(self._msg_id)), msg_to_wire(msg), ttl=ttl)
+            return None
+
+        async def on_subscribed(_ht, args, _prev):
+            id, full_filter = args[0], args[1]
+            session = self.ctx.registry.get(id.client_id)
+            if session is None:
+                return None
+            try:
+                _g, stripped = parse_shared(full_filter)
+            except ValueError:
+                return None
+            for msg_id, mw in self.store.scan(NS_MSG):
+                fwd_key = f"{msg_id}\x00{id.client_id}"
+                if self.store.get(NS_FWD, fwd_key) is not None:
+                    continue  # mark_forwarded dedup
+                msg = msg_from_wire(mw)
+                if msg.is_expired() or not match_filter(stripped, msg.topic):
+                    continue
+                session.enqueue(
+                    DeliverItem(msg=msg, qos=min(msg.qos, 1), retain=False,
+                                topic_filter=full_filter)
+                )
+                self.store.put(NS_FWD, fwd_key, True, ttl=self.default_expiry)
+            return None
+
+        self._unhooks = [
+            hooks.register(HookType.MESSAGE_PUBLISH, on_publish, priority=-50),
+            hooks.register(HookType.SESSION_SUBSCRIBED, on_subscribed),
+        ]
+
+    async def stop(self) -> bool:
+        for un in self._unhooks:
+            un()
+        self._unhooks = []
+        self.store.close()
+        return True
+
+    def attrs(self):
+        return {"stored": self.store.count(NS_MSG)}

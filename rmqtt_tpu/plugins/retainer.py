@@ -1,0 +1,55 @@
+"""Persistent retainer plugin.
+
+Mirrors `rmqtt-plugins/rmqtt-retainer`: retained messages survive restarts.
+On start, retained messages load from SQLite into the in-memory store; every
+local mutation is written through (chained with the cluster's ``on_set`` so
+both persistence and broadcast fire).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.cluster.messages import msg_from_wire, msg_to_wire
+from rmqtt_tpu.plugins import Plugin
+from rmqtt_tpu.storage.sqlite import SqliteStore
+
+NS = "retain"
+
+
+class RetainerPlugin(Plugin):
+    name = "rmqtt-retainer"
+    descr = "persistent retained-message store (sqlite)"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        self.store = SqliteStore(self.config.get("path", ":memory:"))
+        self._prev_on_set = None
+
+    async def start(self) -> None:
+        retain = self.ctx.retain
+        # load persisted retains
+        for topic, mw in self.store.scan(NS):
+            msg = msg_from_wire(mw)
+            if not msg.is_expired():
+                retain.set_local(topic, msg)
+        self._prev_on_set = retain.on_set
+
+        def on_set(topic: str, msg: Optional[Message]) -> None:
+            if msg is None:
+                self.store.delete(NS, topic)
+            else:
+                self.store.put(NS, topic, msg_to_wire(msg), ttl=msg.expiry_interval)
+            if self._prev_on_set is not None:  # chain (cluster broadcast)
+                self._prev_on_set(topic, msg)
+
+        retain.on_set = on_set
+
+    async def stop(self) -> bool:
+        self.ctx.retain.on_set = self._prev_on_set
+        self.store.close()
+        return True
+
+    def attrs(self):
+        return {"persisted": self.store.count(NS)}

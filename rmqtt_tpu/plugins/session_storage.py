@@ -1,0 +1,145 @@
+"""Persistent-session plugin.
+
+Mirrors `rmqtt-plugins/rmqtt-session-storage`: offline sessions (basic info,
+subscriptions, queued messages) persist to SQLite; on broker startup they are
+rebuilt as offline sessions with expiry timers, the reference's
+``offline_restart`` path (`rmqtt/src/session.rs:516-558`), so queued QoS1/2
+messages survive a broker restart until the client returns or the session
+expires.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from rmqtt_tpu.broker.fitter import Limits
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.session import DeliverItem, Session
+from rmqtt_tpu.broker.types import ConnectInfo, Message
+from rmqtt_tpu.cluster.messages import (
+    msg_from_wire,
+    msg_to_wire,
+    opts_from_wire,
+    opts_to_wire,
+)
+from rmqtt_tpu.core.topic import parse_shared
+from rmqtt_tpu.plugins import Plugin
+from rmqtt_tpu.router.base import Id
+
+NS = "session"
+
+
+class SessionStoragePlugin(Plugin):
+    name = "rmqtt-session-storage"
+    descr = "persistent sessions + offline queues (sqlite)"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        from rmqtt_tpu.storage.sqlite import SqliteStore
+
+        self.store = SqliteStore(self.config.get("path", ":memory:"))
+        self._unhooks = []
+
+    def _snapshot(self, s: Session) -> dict:
+        return {
+            "client_id": s.client_id,
+            "node_id": s.id.node_id,
+            "clean_start": s.clean_start,
+            "created_at": s.created_at,
+            "session_expiry": s.limits.session_expiry,
+            "disconnected_at": time.time(),
+            "max_inflight": s.limits.max_inflight,
+            "max_mqueue": s.limits.max_mqueue,
+            "protocol": s.connect_info.protocol,
+            "keepalive": s.connect_info.keepalive,
+            "subs": [[tf, opts_to_wire(o)] for tf, o in s.subscriptions.items()],
+            "queue": [
+                [it.qos, it.retain, it.topic_filter, list(it.sub_ids), msg_to_wire(it.msg)]
+                for it in list(s.deliver_queue._q)
+            ],
+        }
+
+    async def init(self) -> None:
+        hooks = self.ctx.hooks
+
+        async def on_disconnected(_ht, args, _prev):
+            id = args[0]
+            # clean_start only discards the PREVIOUS session at connect time;
+            # persistence is governed by the session expiry alone
+            s = self.ctx.registry.get(id.client_id)
+            if s is not None and s.limits.session_expiry > 0:
+                self.store.put(NS, s.client_id, self._snapshot(s),
+                               ttl=s.limits.session_expiry)
+            return None
+
+        async def on_terminated(_ht, args, _prev):
+            self.store.delete(NS, args[0].client_id)
+            return None
+
+        async def on_connected(_ht, args, _prev):
+            # the live broker now owns this session again
+            self.store.delete(NS, args[0].id.client_id)
+            return None
+
+        self._unhooks = [
+            hooks.register(HookType.CLIENT_DISCONNECTED, on_disconnected),
+            hooks.register(HookType.SESSION_TERMINATED, on_terminated),
+            hooks.register(HookType.CLIENT_CONNECTED, on_connected),
+        ]
+
+    async def start(self) -> None:
+        """Rebuild persisted offline sessions (offline_restart)."""
+        ctx = self.ctx
+        now = time.time()
+        for client_id, snap in self.store.scan(NS):
+            if ctx.registry.get(client_id) is not None:
+                continue
+            remaining = snap["session_expiry"] - (now - snap["disconnected_at"])
+            if remaining <= 0:
+                self.store.delete(NS, client_id)
+                continue
+            id = Id(snap["node_id"], client_id)
+            ci = ConnectInfo(
+                id=id, protocol=snap["protocol"], keepalive=snap["keepalive"],
+                clean_start=False,
+            )
+            limits = Limits(
+                keepalive=snap["keepalive"], server_keepalive=False,
+                max_inflight=snap["max_inflight"], max_mqueue=snap["max_mqueue"],
+                session_expiry=remaining,
+                max_message_expiry=ctx.cfg.fitter.max_message_expiry,
+                max_topic_aliases_in=0, max_topic_aliases_out=0,
+                max_packet_size=ctx.cfg.max_packet_size,
+            )
+            session = Session(ctx, id, ci, limits, clean_start=False)
+            ctx.registry._sessions[client_id] = session
+            for tf, ow in snap["subs"]:
+                opts = opts_from_wire(ow)
+                try:
+                    _group, stripped = parse_shared(tf)
+                except ValueError:
+                    stripped = tf
+                ctx.registry.subscribe(session, tf, stripped, opts)
+            for qos, retain, tf, sub_ids, mw in snap["queue"]:
+                msg = msg_from_wire(mw)
+                if not msg.is_expired():
+                    session.deliver_queue.push(
+                        DeliverItem(msg=msg, qos=qos, retain=retain,
+                                    topic_filter=tf, sub_ids=tuple(sub_ids))
+                    )
+            # arm the expiry timer (offline loop)
+            session._expiry_task = asyncio.get_running_loop().create_task(
+                session._expire(remaining)
+            )
+
+    async def stop(self) -> bool:
+        for un in self._unhooks:
+            un()
+        self._unhooks = []
+        self.store.close()
+        return True
+
+    def attrs(self):
+        return {"stored_sessions": self.store.count(NS)}

@@ -1,0 +1,139 @@
+"""Native C++ runtime bindings (ctypes).
+
+The reference's data plane is native (Rust); here the hot host-side
+structures are C++ (``/root/repo/runtime``) bound via ctypes (no pybind11 in
+this image). Currently: the topic-trie matcher (`runtime/topics.cc`) used as
+(a) the fast host-side router backend (``NativeTrie`` →
+``router.native.NativeRouter``) and (b) the honest CPU baseline in bench.py.
+
+The shared library is built on demand with ``make`` and cached next to the
+sources; environments without a toolchain fall back to the Python trie.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("rmqtt_tpu.runtime")
+
+_RUNTIME_DIR = Path(__file__).resolve().parent.parent.parent / "runtime"
+_LIB_PATH = _RUNTIME_DIR / "librmqtt_runtime.so"
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_RUNTIME_DIR, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
+        log.warning("native runtime build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    src = _RUNTIME_DIR / "topics.cc"
+    if not _LIB_PATH.exists() or (
+        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+    ):
+        if not _build():
+            _build_failed = True
+            return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.rt_trie_new.restype = ctypes.c_void_p
+    lib.rt_trie_free.argtypes = [ctypes.c_void_p]
+    lib.rt_trie_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.rt_trie_add.restype = ctypes.c_int
+    lib.rt_trie_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.rt_trie_remove.restype = ctypes.c_int
+    lib.rt_trie_size.argtypes = [ctypes.c_void_p]
+    lib.rt_trie_size.restype = ctypes.c_int64
+    lib.rt_trie_match.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.rt_trie_match.restype = ctypes.c_int64
+    lib.rt_trie_match_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.rt_trie_match_batch.restype = ctypes.c_int64
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeTrie:
+    """ctypes wrapper over the C++ trie (same semantics as core.trie.TopicTree)."""
+
+    def __init__(self) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no C++ toolchain?)")
+        self._lib = lib
+        self._ptr = ctypes.c_void_p(lib.rt_trie_new())
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.rt_trie_free(ptr)
+            self._ptr = None
+
+    def add(self, topic_filter: str, value: int) -> bool:
+        return bool(self._lib.rt_trie_add(self._ptr, topic_filter.encode(), value))
+
+    def remove(self, topic_filter: str, value: int) -> bool:
+        return bool(self._lib.rt_trie_remove(self._ptr, topic_filter.encode(), value))
+
+    def __len__(self) -> int:
+        return int(self._lib.rt_trie_size(self._ptr))
+
+    def match(self, topic: str, cap: int = 4096) -> np.ndarray:
+        buf = np.empty(cap, dtype=np.int64)
+        n = self._lib.rt_trie_match(
+            self._ptr, topic.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap
+        )
+        if n > cap:  # rare: grow and retry
+            return self.match(topic, cap=int(n))
+        return buf[:n].copy()
+
+    def match_batch(self, topics: Sequence[str], cap_per_topic: int = 64) -> List[np.ndarray]:
+        blob = b"\x00".join(t.encode() for t in topics) + b"\x00"
+        n = len(topics)
+        counts = np.empty(n, dtype=np.int64)
+        cap = max(1, cap_per_topic * n)
+        while True:
+            out = np.empty(cap, dtype=np.int64)
+            total = self._lib.rt_trie_match_batch(
+                self._ptr, blob, n,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+            )
+            if total <= cap:
+                break
+            cap = int(total)
+        rows: List[np.ndarray] = []
+        off = 0
+        for j in range(n):
+            c = int(counts[j])
+            rows.append(out[off : off + c].copy())
+            off += c
+        return rows

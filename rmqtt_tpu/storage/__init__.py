@@ -1,0 +1,9 @@
+"""Persistent storage backends.
+
+The reference persists retained messages, offline messages and sessions via
+`rmqtt-storage` (unified sled/redis KV, SURVEY.md §2.3). Here the embedded
+backend is SQLite (stdlib) behind a small async-friendly wrapper; payloads
+serialize with the cluster wire format (no pickle).
+"""
+
+from rmqtt_tpu.storage.sqlite import SqliteStore

@@ -1,0 +1,89 @@
+"""SQLite-backed KV/table store for broker persistence.
+
+The sled-equivalent embedded backend (reference `rmqtt-storage`): small
+synchronous operations on the event loop are acceptable at broker-control
+rates; bulk scans run in the default executor. WAL mode keeps writers from
+blocking readers across broker restarts/chaos tests.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from pathlib import Path
+from typing import Any, Iterable, List, Optional, Tuple
+
+from rmqtt_tpu.cluster import wire
+
+
+class SqliteStore:
+    def __init__(self, path: str | Path = ":memory:") -> None:
+        self.path = str(path)
+        if self.path != ":memory:":
+            Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self._db = sqlite3.connect(self.path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS kv (
+                ns TEXT NOT NULL, k TEXT NOT NULL, v BLOB NOT NULL,
+                expire_at REAL, PRIMARY KEY (ns, k)
+            );
+            CREATE INDEX IF NOT EXISTS kv_expire ON kv (expire_at)
+                WHERE expire_at IS NOT NULL;
+            """
+        )
+        self._db.commit()
+
+    def close(self) -> None:
+        self._db.close()
+
+    # ------------------------------------------------------------------ kv
+    def put(self, ns: str, key: str, value: Any, ttl: Optional[float] = None) -> None:
+        expire = time.time() + ttl if ttl else None
+        self._db.execute(
+            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+            (ns, key, wire.dumps(value), expire),
+        )
+        self._db.commit()
+
+    def get(self, ns: str, key: str) -> Optional[Any]:
+        row = self._db.execute(
+            "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
+        ).fetchone()
+        if row is None:
+            return None
+        value, expire = row
+        if expire is not None and expire <= time.time():
+            self.delete(ns, key)
+            return None
+        return wire.loads(value)
+
+    def delete(self, ns: str, key: str) -> bool:
+        cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))
+        self._db.commit()
+        return cur.rowcount > 0
+
+    def scan(self, ns: str) -> List[Tuple[str, Any]]:
+        nw = time.time()
+        rows = self._db.execute(
+            "SELECT k, v, expire_at FROM kv WHERE ns=?", (ns,)
+        ).fetchall()
+        out = []
+        for k, v, expire in rows:
+            if expire is not None and expire <= nw:
+                continue
+            out.append((k, wire.loads(v)))
+        return out
+
+    def count(self, ns: str) -> int:
+        (n,) = self._db.execute("SELECT COUNT(*) FROM kv WHERE ns=?", (ns,)).fetchone()
+        return int(n)
+
+    def expire_sweep(self) -> int:
+        cur = self._db.execute(
+            "DELETE FROM kv WHERE expire_at IS NOT NULL AND expire_at <= ?", (time.time(),)
+        )
+        self._db.commit()
+        return cur.rowcount

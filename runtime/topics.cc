@@ -1,0 +1,186 @@
+// C++ topic-trie matcher — the native host-side routing structure.
+//
+// Semantics mirror the reference broker's subscription trie
+// (/root/reference/rmqtt/src/trie.rs, Rust) re-implemented independently in
+// C++ for the host runtime: per-level branches, multi-value nodes, wildcard
+// expansion with the parent-'#' match (trie.rs:330-338), '+' matching blank
+// levels, and $-topic isolation from wildcard-first filters (trie.rs:342-347).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). All strings are
+// UTF-8, levels split on '/'. Thread safety: external (the Python side holds
+// the GIL around calls; a dedicated mutex would go here for a C++ server).
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Node {
+  std::vector<int64_t> values;  // subscription values at this filter node
+  std::unordered_map<std::string, std::unique_ptr<Node>> branches;
+
+  bool empty() const { return values.empty() && branches.empty(); }
+};
+
+struct Trie {
+  Node root;
+  size_t value_count = 0;
+};
+
+std::vector<std::string_view> split_levels(const char* topic) {
+  std::vector<std::string_view> out;
+  const char* start = topic;
+  const char* p = topic;
+  for (;; ++p) {
+    if (*p == '/' || *p == '\0') {
+      out.emplace_back(start, static_cast<size_t>(p - start));
+      if (*p == '\0') break;
+      start = p + 1;
+    }
+  }
+  return out;
+}
+
+bool is_metadata(std::string_view level) { return !level.empty() && level[0] == '$'; }
+
+// DFS collecting matched values (trie.rs MatchedIter semantics).
+void match_node(const Node& node, const std::vector<std::string_view>& path, size_t i,
+                std::vector<int64_t>* out) {
+  if (i == path.size()) {
+    // parent '#' match ...
+    auto h = node.branches.find("#");
+    if (h != node.branches.end()) {
+      const auto& vals = h->second->values;
+      out->insert(out->end(), vals.begin(), vals.end());
+    }
+    // ... and exact match on this node
+    out->insert(out->end(), node.values.begin(), node.values.end());
+    return;
+  }
+  const std::string_view lev = path[i];
+  // $-topic isolation applies at the first level only
+  const bool wildcards_ok = !(i == 0 && is_metadata(lev));
+  if (wildcards_ok) {
+    auto h = node.branches.find("#");
+    if (h != node.branches.end()) {
+      const auto& vals = h->second->values;
+      out->insert(out->end(), vals.begin(), vals.end());
+    }
+    auto plus = node.branches.find("+");
+    if (plus != node.branches.end()) {
+      match_node(*plus->second, path, i + 1, out);
+    }
+  }
+  auto exact = node.branches.find(std::string(lev));
+  if (exact != node.branches.end()) {
+    match_node(*exact->second, path, i + 1, out);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_trie_new() { return new Trie(); }
+
+void rt_trie_free(void* t) { delete static_cast<Trie*>(t); }
+
+// Insert value under filter. Returns 1 if inserted, 0 if already present.
+int rt_trie_add(void* t, const char* filter, int64_t value) {
+  Trie* trie = static_cast<Trie*>(t);
+  Node* node = &trie->root;
+  for (auto lev : split_levels(filter)) {
+    auto& slot = node->branches[std::string(lev)];
+    if (!slot) slot = std::make_unique<Node>();
+    node = slot.get();
+  }
+  for (int64_t v : node->values) {
+    if (v == value) return 0;
+  }
+  node->values.push_back(value);
+  ++trie->value_count;
+  return 1;
+}
+
+// Remove value; prunes empty chains. Returns 1 if removed.
+int rt_trie_remove(void* t, const char* filter, int64_t value) {
+  Trie* trie = static_cast<Trie*>(t);
+  auto levels = split_levels(filter);
+  // walk down, remembering the path for pruning
+  std::vector<std::pair<Node*, std::string>> path;
+  Node* node = &trie->root;
+  for (auto lev : levels) {
+    auto it = node->branches.find(std::string(lev));
+    if (it == node->branches.end()) return 0;
+    path.emplace_back(node, std::string(lev));
+    node = it->second.get();
+  }
+  auto& vals = node->values;
+  for (size_t i = 0; i < vals.size(); ++i) {
+    if (vals[i] == value) {
+      vals[i] = vals.back();
+      vals.pop_back();
+      --trie->value_count;
+      // prune empty chain bottom-up
+      for (auto it = path.rbegin(); it != path.rend(); ++it) {
+        Node* parent = it->first;
+        auto child = parent->branches.find(it->second);
+        if (child != parent->branches.end() && child->second->empty()) {
+          parent->branches.erase(child);
+        } else {
+          break;
+        }
+      }
+      return 1;
+    }
+  }
+  return 0;
+}
+
+int64_t rt_trie_size(void* t) {
+  return static_cast<int64_t>(static_cast<Trie*>(t)->value_count);
+}
+
+// Match one topic; writes up to `cap` matched values into `out`.
+// Returns the TOTAL number of matches (may exceed cap — caller re-calls
+// with a bigger buffer).
+int64_t rt_trie_match(void* t, const char* topic, int64_t* out, int64_t cap) {
+  Trie* trie = static_cast<Trie*>(t);
+  auto path = split_levels(topic);
+  std::vector<int64_t> matches;
+  match_node(trie->root, path, 0, &matches);
+  const int64_t n = static_cast<int64_t>(matches.size());
+  const int64_t copy = n < cap ? n : cap;
+  std::memcpy(out, matches.data(), static_cast<size_t>(copy) * sizeof(int64_t));
+  return n;
+}
+
+// Batched match over NUL-separated topics; per-topic counts go to `counts`.
+// Values are packed back-to-back into `out` (up to cap total); returns the
+// total value count required.
+int64_t rt_trie_match_batch(void* t, const char* topics, int64_t ntopics,
+                            int64_t* counts, int64_t* out, int64_t cap) {
+  Trie* trie = static_cast<Trie*>(t);
+  const char* p = topics;
+  int64_t total = 0;
+  std::vector<int64_t> matches;
+  for (int64_t j = 0; j < ntopics; ++j) {
+    matches.clear();
+    auto path = split_levels(p);
+    match_node(trie->root, path, 0, &matches);
+    counts[j] = static_cast<int64_t>(matches.size());
+    for (int64_t v : matches) {
+      if (total < cap) out[total] = v;
+      ++total;
+    }
+    p += std::strlen(p) + 1;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,97 @@
+"""Native C++ trie: build, bind, and differential-test against the oracle."""
+
+import random
+
+import pytest
+
+from rmqtt_tpu.core.topic import filter_valid, match_filter
+
+runtime = pytest.importorskip("rmqtt_tpu.runtime")
+if not runtime.available():
+    pytest.skip("no C++ toolchain available", allow_module_level=True)
+
+
+def test_native_trie_basics():
+    t = runtime.NativeTrie()
+    assert t.add("a/+/c", 1)
+    assert t.add("a/#", 2)
+    assert not t.add("a/#", 2)  # dedup
+    assert t.add("$SYS/#", 3)
+    assert len(t) == 3
+    assert sorted(t.match("a/b/c").tolist()) == [1, 2]
+    assert t.match("a").tolist() == [2]  # parent '#'
+    assert t.match("$SYS/x").tolist() == [3]  # $-isolation holds for 2
+    assert t.match("zzz").tolist() == []
+    assert t.remove("a/#", 2)
+    assert not t.remove("a/#", 2)
+    assert t.match("a").tolist() == []
+    assert len(t) == 2
+
+
+def test_native_differential():
+    rng = random.Random(17)
+    t = runtime.NativeTrie()
+    fids = {}
+    words = ["a", "b", "c", "", "+", "$s"]
+    i = 0
+    for _ in range(1500):
+        n = rng.randint(1, 6)
+        levels = [rng.choice(words) for _ in range(n)]
+        if rng.random() < 0.35:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if filter_valid(f) and f not in fids.values():
+            t.add(f, i)
+            fids[i] = f
+            i += 1
+    topics = [
+        "/".join(rng.choice(["a", "b", "c", "d", "", "$s"]) for _ in range(rng.randint(1, 7)))
+        for _ in range(400)
+    ]
+    rows = t.match_batch(topics)
+    for topic, row in zip(topics, rows):
+        expect = sorted(v for v, f in fids.items() if match_filter(f, topic))
+        assert sorted(row.tolist()) == expect, topic
+        assert sorted(t.match(topic).tolist()) == expect, topic
+
+
+def test_native_router_agrees_with_default():
+    from rmqtt_tpu.router import DefaultRouter, Id, SubscriptionOptions
+    from rmqtt_tpu.router.native import NativeRouter
+
+    rng = random.Random(9)
+    d, n = DefaultRouter(), NativeRouter()
+    subs = []
+    for i in range(300):
+        depth = rng.randint(1, 5)
+        levels = [rng.choice(["a", "b", "c", "", "+"]) for _ in range(depth)]
+        if rng.random() < 0.3:
+            levels[-1] = "#"
+        tf = "/".join(levels)
+        if not filter_valid(tf):
+            continue
+        sid = Id(1, f"c{i % 40}")
+        opts = SubscriptionOptions(qos=rng.randint(0, 2))
+        subs.append((tf, sid))
+        d.add(tf, sid, opts)
+        n.add(tf, sid, opts)
+    for tf, sid in rng.sample(subs, len(subs) // 3):
+        assert d.remove(tf, sid) == n.remove(tf, sid)
+    assert d.topics_count() == n.topics_count()
+
+    def flat(m):
+        return sorted((node, r.topic_filter, r.id.client_id) for node, v in m.items() for r in v)
+
+    for _ in range(100):
+        topic = "/".join(rng.choice(["a", "b", "c", "d", ""]) for _ in range(rng.randint(1, 6)))
+        assert flat(d.matches(None, topic)) == flat(n.matches(None, topic)), topic
+
+
+def test_large_matchset_regrow():
+    t = runtime.NativeTrie()
+    for i in range(5000):
+        t.add("big/#", i)
+    row = t.match("big/x")  # > default cap → retry path
+    assert len(row) == 5000
+    rows = t.match_batch(["big/x", "nope"], cap_per_topic=4)
+    assert len(rows[0]) == 5000 and len(rows[1]) == 0
