@@ -42,7 +42,13 @@ class BrokerConfig:
     shared_subscription: bool = True
     batch_max: int = 1024
     batch_linger_ms: float = 1.0
-    cluster: bool = False  # use the cluster-aware session registry
+    cluster: bool = False  # use a cluster-aware session registry
+    cluster_mode: str = "broadcast"  # "broadcast" | "raft"
+    # overload protection (reference busy detection, node.rs:212-239 +
+    # handshake executor limits, executor.rs:66-137)
+    max_handshaking: int = 2000
+    max_handshake_rate: float = 0.0  # 0 = unlimited, else handshakes/sec
+    busy_loadavg: float = 0.0  # 0 = ignore; else refuse above load1/ncpu
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -77,7 +83,11 @@ class ServerContext:
             router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
         )
         self.retain = RetainStore(enable=self.cfg.retain_enable, max_retained=self.cfg.retain_max)
-        if self.cfg.cluster:
+        if self.cfg.cluster and self.cfg.cluster_mode == "raft":
+            from rmqtt_tpu.cluster.raft_mode import RaftSessionRegistry
+
+            self.registry = RaftSessionRegistry(self)
+        elif self.cfg.cluster:
             from rmqtt_tpu.cluster.broadcast import ClusterSessionRegistry
 
             self.registry = ClusterSessionRegistry(self)
@@ -88,8 +98,31 @@ class ServerContext:
         self.fitter = Fitter(self.cfg.fitter)
         self.node_id = self.cfg.node_id
         from rmqtt_tpu.plugins import PluginManager
+        from rmqtt_tpu.utils.counter import RateCounter
 
         self.plugins = PluginManager(self)
+        self.handshaking = 0
+        self.handshake_rate = RateCounter(window=5.0)
+
+    def is_busy(self) -> bool:
+        """Overload check before accepting a handshake (context.rs:400-406,
+        node.rs:212-239): too many concurrent handshakes, handshake-rate cap,
+        or 1-minute loadavg per cpu above threshold."""
+        cfg = self.cfg
+        if self.handshaking >= cfg.max_handshaking:
+            return True
+        if cfg.max_handshake_rate and self.handshake_rate.rate() > cfg.max_handshake_rate:
+            return True
+        if cfg.busy_loadavg:
+            import os
+
+            try:
+                load1 = os.getloadavg()[0] / (os.cpu_count() or 1)
+            except OSError:
+                return False
+            if load1 > cfg.busy_loadavg:
+                return True
+        return False
 
     def start(self) -> None:
         self.routing.start()

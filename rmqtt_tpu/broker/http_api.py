@@ -181,7 +181,7 @@ class HttpApi:
             group, stripped = parse_shared(tf)
             if not filter_valid(stripped):
                 return 400, {"error": "invalid filter"}, J
-            ctx.registry.subscribe(
+            await ctx.registry.subscribe(
                 s, tf, stripped,
                 SubscriptionOptions(qos=int(req.get("qos", 0)), shared_group=group),
             )
@@ -191,7 +191,7 @@ class HttpApi:
             s = ctx.registry.get(req["clientid"])
             if s is None:
                 return 404, {"error": "no such client"}, J
-            ok = ctx.registry.unsubscribe(s, req["topic"])
+            ok = await ctx.registry.unsubscribe(s, req["topic"])
             return 200, {"unsubscribed": bool(ok)}, J
         if path == "/metrics/prometheus":
             return 200, self._prometheus().encode(), "text/plain; version=0.0.4"

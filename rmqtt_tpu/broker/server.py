@@ -79,18 +79,34 @@ class MqttBroker:
         peer = writer.get_extra_info("peername")
         codec = MqttCodec(max_inbound_size=ctx.cfg.max_packet_size)
         ctx.metrics.inc("connections.accepted")
+        # overload protection: refuse before reading the CONNECT
+        # (v5.rs:120-125 busy check)
+        if ctx.is_busy():
+            ctx.metrics.inc("handshake.refused_busy")
+            writer.close()
+            return
+        ctx.handshaking += 1
+        ctx.handshake_rate.inc()
         try:
-            connect = await asyncio.wait_for(
-                self._read_connect(reader, codec), timeout=ctx.cfg.max_handshake_delay
-            )
-        except (asyncio.TimeoutError, ProtocolViolation, ConnectionError):
-            ctx.metrics.inc("handshake.failures")
-            writer.close()
-            return
-        if connect is None:
-            writer.close()
-            return
-        await self._handshake(connect, reader, writer, codec, peer)
+            try:
+                connect = await asyncio.wait_for(
+                    self._read_connect(reader, codec), timeout=ctx.cfg.max_handshake_delay
+                )
+            except (asyncio.TimeoutError, ProtocolViolation, ConnectionError):
+                ctx.metrics.inc("handshake.failures")
+                writer.close()
+                return
+            if connect is None:
+                writer.close()
+                return
+            state = await self._handshake(connect, reader, writer, codec, peer)
+        finally:
+            ctx.handshaking -= 1
+        if state is not None:
+            try:
+                await state.run()
+            finally:
+                ctx.metrics.inc("connections.closed")
 
     async def _read_connect(self, reader, codec) -> Optional[pk.Connect]:
         while True:
@@ -104,15 +120,16 @@ class MqttBroker:
                     return None
                 return p
 
-    async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer) -> None:
-        """v5.rs `_handshake` :191-410 (v3 mirror)."""
+    async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer):
+        """v5.rs `_handshake` :191-410 (v3 mirror). Returns the ready
+        SessionState (caller runs it), or None if refused."""
         ctx = self.ctx
         v5 = connect.protocol == pk.V5
         assigned_id = None
         if not connect.client_id:
             if not v5 and not connect.clean_start:
                 await self._refuse(writer, codec, v5, 0x85, 2)
-                return
+                return None
             assigned_id = uuid.uuid4().hex
             connect.client_id = assigned_id
         id = Id(ctx.node_id, connect.client_id)
@@ -137,10 +154,10 @@ class MqttBroker:
             await self._refuse(
                 writer, codec, v5, RC_NOT_AUTHORIZED, V3_NOT_AUTHORIZED
             )
-            return
+            return None
         if connect.keepalive == 0 and not ctx.cfg.allow_zero_keepalive:
             await self._refuse(writer, codec, v5, 0x8D, 2)
-            return
+            return None
         limits = ctx.fitter.fit(ci)
         session, session_present = await ctx.registry.take_or_create(
             ctx, id, ci, limits, connect.clean_start
@@ -173,7 +190,7 @@ class MqttBroker:
             writer.write(codec.encode(connack))
             await writer.drain()
             writer.close()
-            return
+            return None
         # mark the session live BEFORE the CONNACK goes out: the client may
         # act on the CONNACK immediately (counters/kick/cluster queries race
         # otherwise)
@@ -190,13 +207,10 @@ class MqttBroker:
             session.state = None
             session.on_disconnect(clean=False)
             writer.close()
-            return
+            return None
         ctx.metrics.inc("connections.established")
         await ctx.hooks.fire(HookType.CLIENT_CONNECTED, ci, None, None)
-        try:
-            await state.run()
-        finally:
-            ctx.metrics.inc("connections.closed")
+        return state
 
     async def _refuse(self, writer, codec, v5: bool, rc5: int, rc3: int) -> None:
         try:
@@ -223,6 +237,8 @@ async def _amain(args) -> None:
         cli.setdefault("node", {})["router"] = args.router
     if args.cluster_listen is not None:
         cli.setdefault("cluster", {})["listen"] = args.cluster_listen
+    if args.cluster_mode is not None:
+        cli.setdefault("cluster", {})["mode"] = args.cluster_mode
     if args.peer:
         # "<node_id>@<host>:<port>" (reference NodeAddr format,
         # rmqtt-utils/src/lib.rs:121); CLI peers replace file peers
@@ -232,9 +248,12 @@ async def _amain(args) -> None:
     conf.instantiate_plugins(broker.ctx, settings)
     cluster = None
     if settings.cluster_listen:
-        from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+        if settings.broker.cluster_mode == "raft":
+            from rmqtt_tpu.cluster.raft_mode import RaftCluster as ClusterImpl
+        else:
+            from rmqtt_tpu.cluster.broadcast import BroadcastCluster as ClusterImpl
 
-        cluster = BroadcastCluster(broker.ctx, settings.cluster_listen, settings.peers)
+        cluster = ClusterImpl(broker.ctx, settings.cluster_listen, settings.peers)
         await cluster.start()
     api = None
     if settings.http_api:
@@ -264,6 +283,7 @@ def main() -> None:
     ap.add_argument("--node-id", type=int, default=None)
     ap.add_argument("--router", choices=["trie", "native", "xla"], default=None)
     ap.add_argument("--cluster-listen", default=None, help="host:port for cluster RPC")
+    ap.add_argument("--cluster-mode", choices=["broadcast", "raft"], default=None)
     ap.add_argument(
         "--peer", action="append", default=[],
         help="peer node as <node_id>@<host>:<port>; repeatable",

@@ -565,7 +565,12 @@ class SessionState:
             shared_group=group,
         )
         is_new = topic_filter not in s.subscriptions
-        self.ctx.registry.subscribe(s, topic_filter, stripped, sopts)
+        try:
+            await self.ctx.registry.subscribe(s, topic_filter, stripped, sopts)
+        except Exception:
+            # e.g. raft consensus unavailable (no leader / minority partition)
+            self.ctx.metrics.inc("subscribe.errors")
+            return RC_UNSPECIFIED_ERROR
         await self.ctx.hooks.fire(HookType.SESSION_SUBSCRIBED, s.id, topic_filter, None)
         # retained replay (session.rs:1344-1365; retain-handling v5 3.8.3.1)
         if group is None and self._should_send_retained(opts, is_new):
@@ -601,7 +606,7 @@ class SessionState:
         codes = []
         for tf in p.filters:
             await self.ctx.hooks.fire(HookType.CLIENT_UNSUBSCRIBE, s.id, tf, None)
-            ok = self.ctx.registry.unsubscribe(s, tf)
+            ok = await self.ctx.registry.unsubscribe(s, tf)
             if ok:
                 await self.ctx.hooks.fire(HookType.SESSION_UNSUBSCRIBED, s.id, tf, None)
             codes.append(RC_SUCCESS if ok else 0x11)  # 0x11 = no subscription existed

@@ -74,26 +74,41 @@ class SessionRegistry:
         if cur is not session:
             return  # already replaced by a newer session
         del self._sessions[session.client_id]
-        for full_filter, opts in list(session.subscriptions.items()):
-            from rmqtt_tpu.core.topic import parse_shared
+        from rmqtt_tpu.core.topic import parse_shared
 
+        items = []
+        for full_filter, opts in list(session.subscriptions.items()):
             try:
                 _, stripped = parse_shared(full_filter)
             except Exception:
                 stripped = full_filter
-            self.ctx.router.remove(stripped, session.id)
+            items.append((stripped, session.id))
+        if items:
+            await self.router_remove_many(items)
         session.subscriptions.clear()
         await self.ctx.hooks.fire(HookType.SESSION_TERMINATED, session.id, reason, None)
 
     # ------------------------------------------------------------ sub/unsub
-    def subscribe(
+    async def subscribe(
         self, session: Session, full_filter: str, stripped: str, opts: SubscriptionOptions
     ) -> None:
-        """Router add + session bookkeeping (shared.rs:555-574)."""
-        self.ctx.router.add(stripped, session.id, opts)
+        """Router add + session bookkeeping (shared.rs:555-574). Async so
+        cluster modes can await consensus (raft proposals) before SUBACK."""
+        await self.router_add(stripped, session.id, opts)
         session.subscriptions[full_filter] = opts
 
-    def unsubscribe(self, session: Session, full_filter: str) -> bool:
+    async def router_add(self, stripped: str, id, opts) -> None:
+        self.ctx.router.add(stripped, id, opts)
+
+    async def router_remove(self, stripped: str, id) -> None:
+        self.ctx.router.remove(stripped, id)
+
+    async def router_remove_many(self, items) -> None:
+        """Bulk removal (one consensus round in raft mode)."""
+        for stripped, id in items:
+            await self.router_remove(stripped, id)
+
+    async def unsubscribe(self, session: Session, full_filter: str) -> bool:
         from rmqtt_tpu.core.topic import parse_shared
 
         opts = session.subscriptions.pop(full_filter, None)
@@ -103,7 +118,7 @@ class SessionRegistry:
             _, stripped = parse_shared(full_filter)
         except Exception:
             stripped = full_filter
-        self.ctx.router.remove(stripped, session.id)
+        await self.router_remove(stripped, session.id)
         return True
 
     # --------------------------------------------------------------- fanout

@@ -32,6 +32,71 @@ from rmqtt_tpu.router.base import Id, SubRelation
 log = logging.getLogger("rmqtt_tpu.cluster")
 
 
+_UNHANDLED = object()
+
+
+async def handle_common_message(ctx, mtype: str, body) -> object:
+    """RPC handlers shared by broadcast and raft modes (ForwardsTo, Kick,
+    retain sync, counters, liveness). Returns ``_UNHANDLED`` for
+    mode-specific types."""
+    if mtype == M.FORWARDS_TO:
+        msg = M.msg_from_wire(body["msg"])
+        if body.get("p2p"):
+            target = ctx.registry.get(body["p2p"])
+            if target is None:
+                raise ClusterReplyError("no-such-client")  # select_ok tries next peer
+            target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
+            return {"count": 1}
+        count = 0
+        for rw in body["rels"]:
+            rel = M.relation_from_wire(rw)
+            count += ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+        return {"count": count}
+    if mtype == M.KICK:
+        session = ctx.registry.get(body["client_id"])
+        if session is not None:
+            if session.state is not None:
+                await session.state.close(kicked=True)
+                # wait (bounded) for the old loop to unwind so the caller's
+                # new session starts after this one is dead
+                for _ in range(100):
+                    if not session.connected:
+                        break
+                    await asyncio.sleep(0.01)
+            # the session now lives on the caller's node; drop the local
+            # copy entirely (cross-node offline-state transfer is not
+            # implemented yet)
+            await ctx.registry.terminate(session, "cluster-kick")
+            return {"kicked": True}
+        return {"kicked": False}
+    if mtype == M.GET_RETAINS:
+        filt = body.get("filter", "#")
+        items = ctx.retain.all_items() if filt == "#" else ctx.retain.matches(filt)
+        return {"retains": [[topic, M.msg_to_wire(m)] for topic, m in items]}
+    if mtype == M.SET_RETAIN:
+        mw = body.get("msg")
+        if mw is None:
+            ctx.retain.remove_local(body["topic"])
+        else:
+            ctx.retain.set_local(body["topic"], M.msg_from_wire(mw))
+        return None
+    if mtype == M.NUMBER_OF_CLIENTS:
+        return {"count": ctx.registry.connected_count()}
+    if mtype == M.NUMBER_OF_SESSIONS:
+        return {"count": ctx.registry.session_count()}
+    if mtype == M.ONLINE:
+        s = ctx.registry.get(body["client_id"])
+        return {"online": bool(s and s.connected)}
+    if mtype == M.SESSION_STATUS:
+        s = ctx.registry.get(body["client_id"])
+        if s is None:
+            return {"exists": False}
+        return {"exists": True, "online": s.connected, "subs": len(s.subscriptions)}
+    if mtype == M.PING:
+        return {"pong": True}
+    return _UNHANDLED
+
+
 def _cands_to_wire(shared) -> list:
     return [
         [group, tf, [[sid.node_id, sid.client_id, M.opts_to_wire(opts), online]
@@ -198,64 +263,13 @@ class BroadcastCluster:
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
         ctx = self.ctx
         if mtype == M.FORWARDS:
+            # scatter-gather: deliver local non-shared, reply shared candidates
             msg = M.msg_from_wire(body["msg"])
             raw = await ctx.routing.matches_raw(msg.from_id, msg.topic)
             relmap, shared = raw
             count = ctx.registry._deliver_relmap(relmap, msg)
             return {"count": count, "shared": _cands_to_wire(shared)}
-        if mtype == M.FORWARDS_TO:
-            msg = M.msg_from_wire(body["msg"])
-            if body.get("p2p"):
-                target = ctx.registry.get(body["p2p"])
-                if target is None:
-                    raise ClusterReplyError("no-such-client")  # select_ok tries next peer
-                target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
-                return {"count": 1}
-            count = 0
-            for rw in body["rels"]:
-                rel = M.relation_from_wire(rw)
-                count += ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
-            return {"count": count}
-        if mtype == M.KICK:
-            session = ctx.registry.get(body["client_id"])
-            if session is not None:
-                if session.state is not None:
-                    await session.state.close(kicked=True)
-                    # wait (bounded) for the old loop to unwind so the
-                    # caller's new session starts after this one is dead
-                    for _ in range(100):
-                        if not session.connected:
-                            break
-                        await asyncio.sleep(0.01)
-                # the session now lives on the caller's node; drop the local
-                # copy entirely (cross-node offline-state transfer is the
-                # raft mode's OfflineSession feature — not implemented yet)
-                await ctx.registry.terminate(session, "cluster-kick")
-                return {"kicked": True}
-            return {"kicked": False}
-        if mtype == M.GET_RETAINS:
-            filt = body.get("filter", "#")
-            items = ctx.retain.all_items() if filt == "#" else ctx.retain.matches(filt)
-            return {"retains": [[topic, M.msg_to_wire(m)] for topic, m in items]}
-        if mtype == M.SET_RETAIN:
-            mw = body.get("msg")
-            if mw is None:
-                ctx.retain.remove_local(body["topic"])
-            else:
-                ctx.retain.set_local(body["topic"], M.msg_from_wire(mw))
-            return None
-        if mtype == M.NUMBER_OF_CLIENTS:
-            return {"count": ctx.registry.connected_count()}
-        if mtype == M.NUMBER_OF_SESSIONS:
-            return {"count": ctx.registry.session_count()}
-        if mtype == M.ONLINE:
-            s = ctx.registry.get(body["client_id"])
-            return {"online": bool(s and s.connected)}
-        if mtype == M.SESSION_STATUS:
-            s = ctx.registry.get(body["client_id"])
-            if s is None:
-                return {"exists": False}
-            return {"exists": True, "online": s.connected, "subs": len(s.subscriptions)}
-        if mtype == M.PING:
-            return {"pong": True}
+        res = await handle_common_message(ctx, mtype, body)
+        if res is not _UNHANDLED:
+            return res
         raise ValueError(f"unknown cluster message {mtype!r}")

@@ -1,0 +1,330 @@
+"""Minimal Raft consensus for replicated routing state.
+
+The reference's raft mode uses the external `rmqtt-raft` crate (SURVEY.md
+§2.3); there is no Python/C++ drop-in in this image, so this is an
+independent compact Raft: leader election with randomized timeouts,
+AppendEntries log replication with commit on majority, leader forwarding for
+proposals, and full-log catch-up for (re)joining nodes. State is in-memory —
+a restarted node rejoins empty and catches up from the leader's log (the
+reference additionally snapshots+compacts; noted as a production gap).
+
+RPCs ride the cluster transport (`cluster/transport.py`) with message types
+``raft_vote`` / ``raft_append`` / ``raft_propose``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from rmqtt_tpu.cluster.transport import ClusterReplyError, PeerClient, PeerUnavailable
+
+log = logging.getLogger("rmqtt_tpu.raft")
+
+RAFT_VOTE = "raft_vote"
+RAFT_APPEND = "raft_append"
+RAFT_PROPOSE = "raft_propose"
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: int,
+        peers: Dict[int, PeerClient],
+        apply_cb: Callable[[Any], Awaitable[None]],
+        election_timeout: Tuple[float, float] = (0.3, 0.6),
+        heartbeat: float = 0.1,
+    ) -> None:
+        self.node_id = node_id
+        self.peers = peers
+        self.apply_cb = apply_cb
+        self.election_timeout = election_timeout
+        self.heartbeat = heartbeat
+
+        self.term = 0
+        self.voted_for: Optional[int] = None
+        self.log: List[Tuple[int, Any]] = []  # (term, entry)
+        self.commit_index = 0  # 1-based count of committed entries
+        self.last_applied = 0
+        self.state = FOLLOWER
+        self.leader_id: Optional[int] = None
+        self._next_index: Dict[int, int] = {}
+        self._match_index: Dict[int, int] = {}
+        self._last_heartbeat = 0.0
+        self._tasks: List[asyncio.Task] = []
+        self._commit_waiters: Dict[int, asyncio.Future] = {}
+        self._apply_lock = asyncio.Lock()
+        self._stopped = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._last_heartbeat = loop.time()
+        self._tasks = [loop.create_task(self._election_loop())]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def _quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    # ------------------------------------------------------------- election
+    async def _election_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopped:
+            timeout = random.uniform(*self.election_timeout)
+            await asyncio.sleep(timeout / 4)
+            if self.state == LEADER:
+                continue
+            if loop.time() - self._last_heartbeat >= timeout:
+                await self._campaign()
+
+    async def _campaign(self) -> None:
+        self.term += 1
+        self.state = CANDIDATE
+        self.voted_for = self.node_id
+        self.leader_id = None
+        term = self.term
+        last_idx = len(self.log)
+        last_term = self.log[-1][0] if self.log else 0
+        votes = 1
+
+        async def ask(peer: PeerClient):
+            try:
+                return await peer.call(RAFT_VOTE, {
+                    "term": term, "candidate": self.node_id,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }, timeout=self.election_timeout[0])
+            except (PeerUnavailable, ClusterReplyError):
+                return None
+
+        replies = await asyncio.gather(*(ask(p) for p in self.peers.values()))
+        if self.term != term or self.state != CANDIDATE:
+            return  # a newer term interrupted the campaign
+        for reply in replies:
+            if reply is None:
+                continue
+            if reply["term"] > self.term:
+                self._step_down(reply["term"])
+                return
+            if reply.get("granted"):
+                votes += 1
+        if votes >= self._quorum():
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.node_id
+        nxt = len(self.log) + 1
+        self._next_index = {nid: nxt for nid in self.peers}
+        self._match_index = {nid: 0 for nid in self.peers}
+        log.info("raft node %s became leader (term %s)", self.node_id, self.term)
+        self._tasks.append(asyncio.get_running_loop().create_task(self._lead_loop()))
+
+    def _step_down(self, term: int) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+        if self.state != FOLLOWER:
+            log.info("raft node %s steps down (term %s)", self.node_id, self.term)
+        self.state = FOLLOWER
+
+    # ------------------------------------------------------------ leadership
+    async def _lead_loop(self) -> None:
+        while self.state == LEADER and not self._stopped:
+            await self._replicate_all()
+            await asyncio.sleep(self.heartbeat)
+
+    async def _replicate_all(self) -> None:
+        await asyncio.gather(*(self._replicate(nid) for nid in self.peers))
+        self._advance_commit()
+
+    async def _replicate(self, nid: int) -> None:
+        if self.state != LEADER:
+            return
+        peer = self.peers[nid]
+        nxt = self._next_index.get(nid, len(self.log) + 1)
+        prev_index = nxt - 1
+        prev_term = self.log[prev_index - 1][0] if prev_index >= 1 and self.log else 0
+        entries = self.log[prev_index:]
+        try:
+            reply = await peer.call(RAFT_APPEND, {
+                "term": self.term, "leader": self.node_id,
+                "prev_log_index": prev_index, "prev_log_term": prev_term,
+                "entries": [[t, e] for t, e in entries],
+                "leader_commit": self.commit_index,
+            }, timeout=1.0)
+        except (PeerUnavailable, ClusterReplyError):
+            return
+        if reply["term"] > self.term:
+            self._step_down(reply["term"])
+            return
+        if reply.get("success"):
+            self._match_index[nid] = prev_index + len(entries)
+            self._next_index[nid] = self._match_index[nid] + 1
+        else:
+            # follower log diverges/behind: back off (full replay worst case)
+            self._next_index[nid] = max(1, min(nxt - 1, reply.get("match", 0) + 1))
+
+    def _advance_commit(self) -> None:
+        if self.state != LEADER:
+            return
+        for idx in range(len(self.log), self.commit_index, -1):
+            # only entries from the current term commit by counting (Raft §5.4.2)
+            if self.log[idx - 1][0] != self.term:
+                break
+            votes = 1 + sum(1 for m in self._match_index.values() if m >= idx)
+            if votes >= self._quorum():
+                self.commit_index = idx
+                asyncio.get_running_loop().create_task(self._apply_committed())
+                # push the new commit index to followers right away instead
+                # of waiting a heartbeat — keeps the replication-visibility
+                # window on the routing table tight
+                asyncio.get_running_loop().create_task(self._push_commit())
+                break
+
+    async def _push_commit(self) -> None:
+        if self.state == LEADER:
+            await asyncio.gather(*(self._replicate(nid) for nid in self.peers))
+
+    async def _apply_committed(self) -> None:
+        async with self._apply_lock:
+            while self.last_applied < self.commit_index:
+                self.last_applied += 1
+                _term, entry = self.log[self.last_applied - 1]
+                try:
+                    await self.apply_cb(entry)
+                except Exception:
+                    log.exception("raft apply failed at %s", self.last_applied)
+                fut = self._commit_waiters.pop(self.last_applied, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(True)
+
+    # -------------------------------------------------------------- propose
+    async def propose(self, entry: Any, timeout: float = 5.0) -> bool:
+        """Append via the leader; resolves once the entry is APPLIED locally.
+        Followers forward to the leader (reference proposals with retry,
+        cluster-raft/src/router.rs:146-196)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        backoff = 0.05
+        while True:
+            if self.state == LEADER:
+                self.log.append((self.term, entry))
+                idx = len(self.log)
+                fut = asyncio.get_running_loop().create_future()
+                self._commit_waiters[idx] = fut
+                await self._replicate_all()
+                try:
+                    remaining = deadline - asyncio.get_running_loop().time()
+                    await asyncio.wait_for(fut, max(0.05, remaining))
+                    return True
+                except asyncio.TimeoutError:
+                    self._commit_waiters.pop(idx, None)
+                    return False
+            elif self.leader_id is not None and self.leader_id in self.peers:
+                try:
+                    reply = await self.peers[self.leader_id].call(
+                        RAFT_PROPOSE, {"entry": entry},
+                        timeout=max(0.1, deadline - asyncio.get_running_loop().time()),
+                    )
+                    if reply.get("ok"):
+                        # wait until the entry reaches *this* node's state
+                        await self._wait_applied(reply["index"], deadline)
+                        return True
+                except (PeerUnavailable, ClusterReplyError):
+                    pass
+            if asyncio.get_running_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 0.5)
+
+    async def _wait_applied(self, index: int, deadline: float) -> None:
+        while self.last_applied < index:
+            if asyncio.get_running_loop().time() >= deadline:
+                raise asyncio.TimeoutError
+            await asyncio.sleep(0.02)
+
+    # ------------------------------------------------------------- handlers
+    async def on_message(self, mtype: str, body: Any) -> Optional[dict]:
+        """Dispatch raft RPCs (wired into the ClusterServer handler)."""
+        if mtype == RAFT_VOTE:
+            return self._on_vote(body)
+        if mtype == RAFT_APPEND:
+            return await self._on_append(body)
+        if mtype == RAFT_PROPOSE:
+            if self.state != LEADER:
+                raise ClusterReplyError("not leader")
+            self.log.append((self.term, body["entry"]))
+            idx = len(self.log)
+            fut = asyncio.get_running_loop().create_future()
+            self._commit_waiters[idx] = fut
+            await self._replicate_all()
+            try:
+                await asyncio.wait_for(fut, 5.0)
+            except asyncio.TimeoutError as e:
+                raise ClusterReplyError("commit timeout") from e
+            return {"ok": True, "index": idx}
+        return None
+
+    def _on_vote(self, body: dict) -> dict:
+        term = body["term"]
+        if term > self.term:
+            self._step_down(term)
+        granted = False
+        if term >= self.term and self.voted_for in (None, body["candidate"]):
+            my_last_term = self.log[-1][0] if self.log else 0
+            up_to_date = (body["last_log_term"], body["last_log_index"]) >= (
+                my_last_term, len(self.log)
+            )
+            if up_to_date:
+                granted = True
+                self.voted_for = body["candidate"]
+                self._last_heartbeat = asyncio.get_running_loop().time()
+        return {"term": self.term, "granted": granted}
+
+    async def _on_append(self, body: dict) -> dict:
+        term = body["term"]
+        if term < self.term:
+            return {"term": self.term, "success": False, "match": self.last_applied}
+        if term > self.term:
+            self._step_down(term)
+        elif self.state != FOLLOWER:
+            self.state = FOLLOWER
+        self.leader_id = body["leader"]
+        self._last_heartbeat = asyncio.get_running_loop().time()
+        prev_index = body["prev_log_index"]
+        prev_term = body["prev_log_term"]
+        if prev_index > len(self.log) or (
+            prev_index >= 1 and self.log[prev_index - 1][0] != prev_term
+        ):
+            return {"term": self.term, "success": False, "match": self.commit_index}
+        # append, truncating only on an actual conflict (Raft §5.3 — a
+        # reordered stale AppendEntries must not clobber newer entries)
+        for i, (t, e) in enumerate([(t, e) for t, e in body["entries"]]):
+            pos = prev_index + i
+            if pos < len(self.log):
+                if self.log[pos][0] != t:
+                    self.log = self.log[:pos]
+                    self.log.append((t, e))
+            else:
+                self.log.append((t, e))
+        if body["leader_commit"] > self.commit_index:
+            self.commit_index = min(body["leader_commit"], len(self.log))
+            await self._apply_committed()
+        return {"term": self.term, "success": True, "match": len(self.log)}

@@ -1,0 +1,238 @@
+"""Raft cluster mode: strongly-consistent replicated routing.
+
+Mirrors `rmqtt-plugins/rmqtt-cluster-raft` (SURVEY.md §2.3): every node holds
+the FULL route table; subscription add/remove go through Raft proposals and
+apply on every node (`src/router.rs:146-196, 350-353`), so `matches()` stays
+node-local with no per-publish consensus (:199-201). Publish fan-out matches
+locally and sends targeted ``ForwardsTo`` to the nodes owning remote
+subscribers (`src/shared.rs:454-538`). Cross-node kick and retain sync reuse
+the broadcast-mode RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from rmqtt_tpu.broker.session import DeliverItem
+from rmqtt_tpu.broker.shared import SessionRegistry
+from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.cluster import messages as M
+from rmqtt_tpu.cluster.broadcast import _UNHANDLED, handle_common_message
+from rmqtt_tpu.cluster.raft import RAFT_APPEND, RAFT_PROPOSE, RAFT_VOTE, RaftNode
+from rmqtt_tpu.cluster.transport import (
+    Broadcaster,
+    ClusterReplyError,
+    ClusterServer,
+    PeerClient,
+    PeerUnavailable,
+)
+from rmqtt_tpu.router.base import Id, SubRelation
+
+log = logging.getLogger("rmqtt_tpu.cluster.raft")
+
+
+class RaftSessionRegistry(SessionRegistry):
+    """Registry whose router mutations go through Raft and whose fan-out
+    sends targeted ForwardsTo to subscriber-owning nodes."""
+
+    def __init__(self, ctx) -> None:
+        super().__init__(ctx)
+        self.cluster: Optional["RaftCluster"] = None
+
+    # subscription writes → consensus (router.rs:146-196)
+    async def router_add(self, stripped: str, id, opts) -> None:
+        c = self.cluster
+        if c is None or not c.peers:
+            self.ctx.router.add(stripped, id, opts)
+            return
+        ok = await c.raft.propose(
+            {"op": "add", "tf": stripped, "node": id.node_id,
+             "client": id.client_id, "opts": M.opts_to_wire(opts)}
+        )
+        if not ok:
+            # the entry may still commit later (it stays in the log);
+            # compensate so a late commit can't leave a ghost route
+            task = asyncio.get_running_loop().create_task(
+                c.raft.propose({"op": "remove", "tf": stripped,
+                                "node": id.node_id, "client": id.client_id},
+                               timeout=30.0)
+            )
+            c._bg_tasks.add(task)
+            task.add_done_callback(c._bg_tasks.discard)
+            raise ClusterReplyError("raft propose (add) failed")
+
+    async def router_remove(self, stripped: str, id) -> None:
+        c = self.cluster
+        if c is None or not c.peers:
+            self.ctx.router.remove(stripped, id)
+            return
+        await c.raft.propose(
+            {"op": "remove", "tf": stripped, "node": id.node_id, "client": id.client_id}
+        )
+
+    async def router_remove_many(self, items) -> None:
+        """One consensus round for a whole session's removals (terminate)."""
+        c = self.cluster
+        if c is None or not c.peers:
+            for stripped, id in items:
+                self.ctx.router.remove(stripped, id)
+            return
+        await c.raft.propose({
+            "op": "remove_many",
+            "items": [[stripped, id.node_id, id.client_id] for stripped, id in items],
+        })
+
+    async def forwards(self, msg: Message) -> int:
+        c = self.cluster
+        if c is None or not c.peers:
+            return await super().forwards(msg)
+        if msg.target_clientid is not None:
+            if self._sessions.get(msg.target_clientid) is not None:
+                return await super().forwards(msg)
+            try:
+                await c.bcast.select_ok(M.FORWARDS_TO, {
+                    "msg": M.msg_to_wire(msg), "rels": [], "p2p": msg.target_clientid,
+                })
+                return 1
+            except (PeerUnavailable, ClusterReplyError):
+                return 0
+        # match locally over the replicated table (shared.rs:461-467)
+        relmap, shared = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
+        count = 0
+        remote: Dict[int, List[SubRelation]] = {}
+        for node_id, rels in relmap.items():
+            if node_id == self.ctx.node_id:
+                for rel in rels:
+                    count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+            else:
+                remote.setdefault(node_id, []).extend(rels)
+        # shared groups: all candidates are in the replicated table — choose
+        # here, globally (router.rs:236-255 does the choice at match time)
+        my_node = self.ctx.node_id
+        for (group, tf), cands in shared.items():
+            # remote members' liveness is unknown locally — treat them as
+            # online so they aren't starved out of the group choice
+            cands = [
+                (sid, opts, on if sid.node_id == my_node else True)
+                for sid, opts, on in cands
+            ]
+            idx = self.ctx.router._shared_choice(group, tf, cands)
+            if idx is None:
+                continue
+            sid, opts, _ = cands[idx]
+            if sid.node_id == my_node:
+                count += self._deliver_local(sid.client_id, tf, opts, msg)
+            else:
+                remote.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
+        for node_id, rels in remote.items():
+            peer = c.peers.get(node_id)
+            if peer is None:
+                continue
+            try:
+                await peer.notify(M.FORWARDS_TO, {
+                    "msg": M.msg_to_wire(msg),
+                    "rels": [M.relation_to_wire(r) for r in rels],
+                    "p2p": None,
+                })
+                count += len(rels)
+            except PeerUnavailable:
+                log.warning("raft ForwardsTo to node %s failed", node_id)
+        return count
+
+    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
+        if self.cluster is not None and self.cluster.peers:
+            await self.cluster.bcast.join_all_call(
+                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
+            )
+        return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
+
+
+class RaftCluster:
+    """Raft node + cluster RPC server, swapped in like the broadcast mode."""
+
+    def __init__(
+        self,
+        ctx,
+        listen: Tuple[str, int],
+        peers: List[Tuple[int, str, int]],
+        sync_retains: bool = True,
+    ) -> None:
+        self.ctx = ctx
+        self.server = ClusterServer(listen[0], listen[1], self._on_message)
+        self.peers: Dict[int, PeerClient] = {
+            nid: PeerClient(nid, host, port) for nid, host, port in peers
+        }
+        self.bcast = Broadcaster(list(self.peers.values()))
+        self.sync_retains = sync_retains
+        self.raft = RaftNode(ctx.node_id, self.peers, self._apply)
+        assert isinstance(ctx.registry, RaftSessionRegistry), (
+            "raft mode needs ServerContext with registry='raft'"
+        )
+        ctx.registry.cluster = self
+        ctx.retain.on_set = self._on_retain_set
+        self._bg_tasks: set = set()
+
+    @property
+    def bound_port(self) -> int:
+        return self.server.bound_port
+
+    async def start(self) -> None:
+        await self.server.start()
+        self.raft.start()
+
+    async def start_sync(self) -> None:
+        if not self.sync_retains or not self.peers:
+            return
+        for _nid, reply in await self.bcast.join_all_call(M.GET_RETAINS, {"filter": "#"}):
+            if isinstance(reply, Exception):
+                continue
+            for topic, mw in reply.get("retains", []):
+                self.ctx.retain.set_local(topic, M.msg_from_wire(mw))
+
+    async def stop(self) -> None:
+        await self.raft.stop()
+        await self.server.stop()
+        for p in self.peers.values():
+            await p.close()
+
+    # ------------------------------------------------------- replicated ops
+    async def _apply(self, entry: Any) -> None:
+        """Apply a committed routing op to the LOCAL router (Store::apply,
+        cluster-raft/src/router.rs:269-364)."""
+        op = entry.get("op")
+        if op == "add":
+            self.ctx.router.add(
+                entry["tf"], Id(entry["node"], entry["client"]),
+                M.opts_from_wire(entry["opts"]),
+            )
+        elif op == "remove":
+            self.ctx.router.remove(entry["tf"], Id(entry["node"], entry["client"]))
+        elif op == "remove_many":
+            for tf, node, client in entry["items"]:
+                self.ctx.router.remove(tf, Id(node, client))
+        else:
+            log.warning("unknown raft entry %r", op)
+
+    def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
+        async def push():
+            await self.bcast.join_all_notify(
+                M.SET_RETAIN,
+                {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
+            )
+
+        task = asyncio.get_running_loop().create_task(push())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    # -------------------------------------------------------------- inbound
+    async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
+        if mtype in (RAFT_VOTE, RAFT_APPEND, RAFT_PROPOSE):
+            return await self.raft.on_message(mtype, body)
+        if mtype == M.PING:
+            return {"pong": True, "leader": self.raft.leader_id, "term": self.raft.term}
+        res = await handle_common_message(self.ctx, mtype, body)
+        if res is not _UNHANDLED:
+            return res
+        raise ValueError(f"unknown cluster message {mtype!r}")

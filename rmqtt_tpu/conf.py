@@ -123,6 +123,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         host, _, port = str(cluster["listen"]).rpartition(":")
         cluster_listen = (host or "0.0.0.0", int(port))
         broker_kwargs["cluster"] = True
+        broker_kwargs["cluster_mode"] = cluster.get("mode", "broadcast")
         for spec in cluster.get("peers", []):
             nid, _, addr = str(spec).partition("@")
             phost, _, pport = addr.rpartition(":")

@@ -39,7 +39,7 @@ class AutoSubscriptionPlugin(Plugin):
                     continue
                 if not filter_valid(stripped):
                     continue
-                self.ctx.registry.subscribe(
+                await self.ctx.registry.subscribe(
                     session, tf, stripped, SubscriptionOptions(qos=qos, shared_group=group)
                 )
             return None

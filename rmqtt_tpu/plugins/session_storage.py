@@ -121,7 +121,7 @@ class SessionStoragePlugin(Plugin):
                     _group, stripped = parse_shared(tf)
                 except ValueError:
                     stripped = tf
-                ctx.registry.subscribe(session, tf, stripped, opts)
+                await ctx.registry.subscribe(session, tf, stripped, opts)
             for qos, retain, tf, sub_ids, mw in snap["queue"]:
                 msg = msg_from_wire(mw)
                 if not msg.is_expired():

@@ -1,0 +1,186 @@
+"""Raft cluster mode: election, replicated routing, failover.
+
+The reference's raft mode replicates the full route table so matching stays
+node-local (`rmqtt-cluster-raft/src/router.rs:199-201`); these tests run 3
+real broker nodes in one loop with real TCP between them.
+"""
+
+import asyncio
+
+import pytest
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.cluster.raft_mode import RaftCluster
+from rmqtt_tpu.cluster.transport import PeerClient
+
+from tests.mqtt_client import TestClient
+
+
+async def make_raft_cluster(n=3):
+    brokers = []
+    for i in range(n):
+        ctx = ServerContext(BrokerConfig(port=0, node_id=i + 1, cluster=True,
+                                         cluster_mode="raft"))
+        b = MqttBroker(ctx)
+        await b.start()
+        brokers.append(b)
+    clusters = []
+    for b in brokers:
+        c = RaftCluster(b.ctx, ("127.0.0.1", 0), [])
+        await c.server.start()
+        clusters.append(c)
+    for i, c in enumerate(clusters):
+        for j, other in enumerate(clusters):
+            if i != j:
+                nid = brokers[j].ctx.node_id
+                c.peers[nid] = PeerClient(nid, "127.0.0.1", other.bound_port)
+        c.bcast.peers = list(c.peers.values())
+        c.raft.peers = c.peers
+        c.raft.start()
+    return brokers, clusters
+
+
+async def wait_leader(clusters, timeout=8.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        leaders = [c for c in clusters if c.raft.is_leader]
+        if len(leaders) == 1:
+            # every live node agrees on who leads
+            lid = leaders[0].raft.node_id
+            if all(c.raft.leader_id == lid for c in clusters if not c.raft._stopped):
+                return leaders[0]
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"no stable leader: {[ (c.raft.node_id, c.raft.state, c.raft.leader_id) for c in clusters]}")
+
+
+async def teardown(brokers, clusters):
+    for c in clusters:
+        await c.stop()
+    for b in brokers:
+        await b.stop()
+
+
+def raft_test(fn):
+    def wrapper():
+        async def run():
+            brokers, clusters = await make_raft_cluster(3)
+            try:
+                await asyncio.wait_for(fn(brokers, clusters), timeout=60.0)
+            finally:
+                await teardown(brokers, clusters)
+
+        asyncio.run(run())
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+@raft_test
+async def test_election_single_leader(brokers, clusters):
+    leader = await wait_leader(clusters)
+    assert sum(1 for c in clusters if c.raft.is_leader) == 1
+    assert all(c.raft.leader_id == leader.raft.node_id for c in clusters)
+
+
+@raft_test
+async def test_replicated_routing_and_forwards(brokers, clusters):
+    await wait_leader(clusters)
+    b1, b2, b3 = brokers
+    # subscribe on node 3 (follower or leader — don't care)
+    sub = await TestClient.connect(b3.port, "raft-sub")
+    ack = await sub.subscribe("r/+/t", qos=1)
+    assert ack.reason_codes[0] < 0x80
+    # route table is replicated: every node knows the filter
+    await asyncio.sleep(0.3)
+    for b in brokers:
+        assert b.ctx.router.topics_count() == 1, b.ctx.node_id
+    # publish on node 1: local match + targeted forward to node 3
+    pub = await TestClient.connect(b1.port, "raft-pub")
+    await pub.publish("r/x/t", b"across", qos=1)
+    p = await sub.recv()
+    assert p.payload == b"across"
+    # unsubscribe removes everywhere
+    await sub.unsubscribe("r/+/t")
+    await asyncio.sleep(0.3)
+    for b in brokers:
+        assert b.ctx.router.topics_count() == 0, b.ctx.node_id
+
+
+@raft_test
+async def test_shared_group_across_raft_cluster(brokers, clusters):
+    await wait_leader(clusters)
+    b1, b2, b3 = brokers
+    w1 = await TestClient.connect(b1.port, "rw1", version=pk.V5)
+    w2 = await TestClient.connect(b2.port, "rw2", version=pk.V5)
+    await w1.subscribe("$share/g/rjobs/#", qos=1)
+    await w2.subscribe("$share/g/rjobs/#", qos=1)
+    pub = await TestClient.connect(b3.port, "rpub")
+    n = 8
+    for i in range(n):
+        await pub.publish("rjobs/t", str(i).encode(), qos=1)
+    await asyncio.sleep(0.5)
+    total = w1.publishes.qsize() + w2.publishes.qsize()
+    assert total == n
+
+
+@raft_test
+async def test_leader_failover(brokers, clusters):
+    leader = await wait_leader(clusters)
+    survivors = [c for c in clusters if c is not leader]
+    surviving_brokers = [b for b, c in zip(brokers, clusters) if c is not leader]
+    # kill the leader node entirely
+    await leader.stop()
+    new_leader = await wait_leader(survivors, timeout=10.0)
+    assert new_leader is not leader
+    # the remaining cluster still accepts subscriptions and routes
+    b_a, b_b = surviving_brokers
+    sub = await TestClient.connect(b_a.port, "failover-sub")
+    ack = await sub.subscribe("fo/t", qos=1)
+    assert ack.reason_codes[0] < 0x80
+    # routing-table visibility on the publisher's node is eventual (applies
+    # on commit propagation); wait for it like a real cluster client would
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while b_b.ctx.router.topics_count() < 1:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+    pub = await TestClient.connect(b_b.port, "failover-pub")
+    await pub.publish("fo/t", b"still-routing", qos=1)
+    p = await sub.recv()
+    assert p.payload == b"still-routing"
+
+
+@raft_test
+async def test_late_joiner_catches_up(brokers, clusters):
+    await wait_leader(clusters)
+    b1 = brokers[0]
+    sub = await TestClient.connect(b1.port, "early-sub")
+    await sub.subscribe("catchup/t", qos=1)
+    await asyncio.sleep(0.3)
+    # a fresh node joins the mesh
+    ctx = ServerContext(BrokerConfig(port=0, node_id=4, cluster=True, cluster_mode="raft"))
+    b4 = MqttBroker(ctx)
+    await b4.start()
+    c4 = RaftCluster(ctx, ("127.0.0.1", 0), [])
+    await c4.server.start()
+    for b, c in zip(brokers, clusters):
+        c4.peers[b.ctx.node_id] = PeerClient(b.ctx.node_id, "127.0.0.1", c.bound_port)
+        c.peers[4] = PeerClient(4, "127.0.0.1", c4.bound_port)
+        c.bcast.peers = list(c.peers.values())
+        c.raft.peers = c.peers
+    c4.bcast.peers = list(c4.peers.values())
+    c4.raft.peers = c4.peers
+    c4.raft.start()
+    # the leader replicates the full log to the newcomer
+    deadline = asyncio.get_running_loop().time() + 8.0
+    while ctx.router.topics_count() < 1:
+        assert asyncio.get_running_loop().time() < deadline, "no catch-up"
+        await asyncio.sleep(0.05)
+    # publishing on the new node reaches the old subscriber
+    pub = await TestClient.connect(b4.port, "late-pub")
+    await pub.publish("catchup/t", b"from-newbie", qos=1)
+    p = await sub.recv()
+    assert p.payload == b"from-newbie"
+    await c4.stop()
+    await b4.stop()

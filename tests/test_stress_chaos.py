@@ -1,0 +1,171 @@
+"""Stress + chaos tiers (reference `rmqtt-test/src/tests/{stress,chaos}`).
+
+Scaled for CI wall-clock: connection storms, fan-out load, abrupt-disconnect
+chaos, and broker kill/restart recovery with persistent sessions — the same
+scenarios as the reference's load_v311/fanout/restart suites, sized down.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from rmqtt_tpu.broker.codec import packets as pk, props as P
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+
+from tests.mqtt_client import TestClient
+
+
+def run_async(fn, timeout=90.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+def test_connection_storm():
+    """Many concurrent connects + subscribes (stress/load_v311 analogue)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        n = int(os.environ.get("STRESS_CLIENTS", "150"))
+
+        async def one(i):
+            c = await TestClient.connect(b.port, f"storm-{i}")
+            await c.subscribe(f"storm/{i % 10}/+", qos=1)
+            return c
+
+        clients = await asyncio.gather(*(one(i) for i in range(n)))
+        assert b.ctx.registry.connected_count() == n
+        # one publish fans out to n/10 subscribers
+        pub = await TestClient.connect(b.port, "storm-pub")
+        await pub.publish("storm/3/x", b"fan", qos=1)
+        hit = [c for i, c in enumerate(clients) if i % 10 == 3]
+        for c in hit:
+            p = await c.recv(timeout=5.0)
+            assert p.payload == b"fan"
+        for c in clients:
+            await c.close()
+        await b.stop()
+
+    run_async(run)
+
+
+def test_fanout_throughput():
+    """Sustained pub → many-subscriber fan-out (stress/fanout analogue)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        nsubs, nmsgs = 40, 50
+        subs = []
+        for i in range(nsubs):
+            c = await TestClient.connect(b.port, f"fan-{i}")
+            await c.subscribe("firehose/#", qos=0)
+            subs.append(c)
+        pub = await TestClient.connect(b.port, "fan-pub")
+        for i in range(nmsgs):
+            await pub.publish("firehose/t", str(i).encode(), qos=0, wait_ack=False)
+        await pub.ping()  # flush ordering barrier
+        await asyncio.sleep(1.0)
+        # QoS0 under load may drop at the queue, but the vast majority lands
+        total = sum(c.publishes.qsize() for c in subs)
+        assert total >= nsubs * nmsgs * 0.9, total
+        for c in subs:
+            await c.close()
+        await b.stop()
+
+    run_async(run)
+
+
+def test_chaos_abrupt_disconnects():
+    """Random mid-flight socket kills must not wedge the broker
+    (chaos/disconnect analogue)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        rng = random.Random(1)
+        stable = await TestClient.connect(b.port, "chaos-stable")
+        await stable.subscribe("chaos/#", qos=1)
+        for round_ in range(5):
+            clients = []
+            for i in range(20):
+                c = await TestClient.connect(
+                    b.port, f"chaos-{round_}-{i}",
+                    will=pk.Will(f"chaos/will/{i}", b"died") if rng.random() < 0.5 else None,
+                )
+                clients.append(c)
+            for c in clients:
+                if rng.random() < 0.7:
+                    c.abort()  # no DISCONNECT
+                else:
+                    await c.disconnect_clean()
+            await asyncio.sleep(0.05)
+        # broker still routes fine
+        pub = await TestClient.connect(b.port, "chaos-pub")
+        await pub.publish("chaos/alive", b"yes", qos=1)
+        while True:
+            p = await stable.recv(timeout=5.0)
+            if p.topic == "chaos/alive":
+                break  # wills may arrive first
+        await b.stop()
+
+    run_async(run)
+
+
+def test_chaos_broker_restart_recovery(tmp_path):
+    """Kill the broker; restart; persistent state must recover
+    (chaos/restart analogue, with session+retain storage)."""
+
+    from rmqtt_tpu.plugins.retainer import RetainerPlugin
+    from rmqtt_tpu.plugins.session_storage import SessionStoragePlugin
+
+    rdb, sdb = tmp_path / "r.db", tmp_path / "s.db"
+
+    def build():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(RetainerPlugin(b.ctx, {"path": str(rdb)}))
+        b.ctx.plugins.register(SessionStoragePlugin(b.ctx, {"path": str(sdb)}))
+        return b
+
+    async def phase1():
+        b = build()
+        await b.start()
+        c = await TestClient.connect(
+            b.port, "survivor", version=pk.V5,
+            properties={P.SESSION_EXPIRY_INTERVAL: 600},
+        )
+        await c.subscribe("state/#", qos=1)
+        await c.publish("state/retained", b"hold", retain=True, qos=1)
+        await c.recv()  # own delivery
+        c.abort()  # simulate client crash
+        await asyncio.sleep(0.1)
+        await b.stop()  # simulate broker crash/stop
+
+    async def phase2():
+        b = build()
+        await b.start()
+        # queue a message for the offline restored session
+        pub = await TestClient.connect(b.port, "after-pub")
+        await pub.publish("state/queued", b"for-survivor", qos=1)
+        await asyncio.sleep(0.1)
+        c = await TestClient.connect(
+            b.port, "survivor", version=pk.V5, clean_start=False,
+            properties={P.SESSION_EXPIRY_INTERVAL: 600},
+        )
+        assert c.connack.session_present
+        got = {}
+        for _ in range(1):
+            p = await c.recv(timeout=5.0)
+            got[p.topic] = p.payload
+        assert got.get("state/queued") == b"for-survivor"
+        # retained survived both restarts
+        fresh = await TestClient.connect(b.port, "fresh")
+        await fresh.subscribe("state/retained")
+        p = await fresh.recv()
+        assert p.payload == b"hold" and p.retain
+        await b.stop()
+
+    run_async(phase1)
+    run_async(phase2)
