@@ -26,6 +26,13 @@ from rmqtt_tpu.router.base import Router
 class BrokerConfig:
     host: str = "127.0.0.1"
     port: int = 1883
+    # additional listeners (None = disabled, 0 = ephemeral); the reference
+    # rmqtt-net supports TCP/TLS/WS/WSS (+QUIC, needs an external stack)
+    ws_port: Optional[int] = None
+    tls_port: Optional[int] = None
+    wss_port: Optional[int] = None
+    tls_cert: str = ""
+    tls_key: str = ""
     node_id: int = 1
     router: str = "trie"  # "trie" (DefaultRouter) | "xla" (TPU)
     allow_anonymous: bool = True

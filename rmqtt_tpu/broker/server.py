@@ -41,6 +41,24 @@ class MqttBroker:
     def __init__(self, ctx: Optional[ServerContext] = None, **cfg_kwargs) -> None:
         self.ctx = ctx or ServerContext(BrokerConfig(**cfg_kwargs))
         self._server: Optional[asyncio.base_events.Server] = None
+        self._ws_server: Optional[asyncio.base_events.Server] = None
+        self._tls_server: Optional[asyncio.base_events.Server] = None
+        self._wss_server: Optional[asyncio.base_events.Server] = None
+
+    def _bound(self, srv) -> int:
+        return srv.sockets[0].getsockname()[1]
+
+    @property
+    def ws_port(self) -> int:
+        return self._bound(self._ws_server)
+
+    @property
+    def tls_port(self) -> int:
+        return self._bound(self._tls_server)
+
+    @property
+    def wss_port(self) -> int:
+        return self._bound(self._wss_server)
 
     @property
     def port(self) -> int:
@@ -50,10 +68,34 @@ class MqttBroker:
         await self.ctx.hooks.fire(HookType.BEFORE_STARTUP)
         self.ctx.start()
         await self.ctx.plugins.start_all()
-        self._server = await asyncio.start_server(
-            self._on_connection, self.ctx.cfg.host, self.ctx.cfg.port
-        )
-        log.info("listening on %s:%s", self.ctx.cfg.host, self.port)
+        cfg = self.ctx.cfg
+        self._server = await asyncio.start_server(self._on_connection, cfg.host, cfg.port)
+        log.info("listening on %s:%s", cfg.host, self.port)
+        sslctx = None
+        if cfg.tls_port is not None or cfg.wss_port is not None:
+            if not cfg.tls_cert:
+                raise ValueError(
+                    "listener.tls_port/wss_port configured without listener.tls_cert"
+                )
+            import ssl
+
+            sslctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            sslctx.load_cert_chain(cfg.tls_cert, cfg.tls_key or None)
+        if cfg.ws_port is not None:
+            self._ws_server = await asyncio.start_server(
+                self._on_ws_connection, cfg.host, cfg.ws_port
+            )
+            log.info("ws listening on %s:%s", cfg.host, self.ws_port)
+        if cfg.tls_port is not None and sslctx:
+            self._tls_server = await asyncio.start_server(
+                self._on_connection, cfg.host, cfg.tls_port, ssl=sslctx
+            )
+            log.info("tls listening on %s:%s", cfg.host, self.tls_port)
+        if cfg.wss_port is not None and sslctx:
+            self._wss_server = await asyncio.start_server(
+                self._on_ws_connection, cfg.host, cfg.wss_port, ssl=sslctx
+            )
+            log.info("wss listening on %s:%s", cfg.host, self.wss_port)
 
     async def stop(self) -> None:
         # close sessions BEFORE wait_closed(): in py3.12 Server.wait_closed
@@ -62,9 +104,10 @@ class MqttBroker:
         for session in self.ctx.registry.sessions():
             if session.state is not None:
                 await session.state.close()
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        for srv in (self._server, self._ws_server, self._tls_server, self._wss_server):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
         await self.ctx.plugins.stop_all()
         await self.ctx.stop()
 
@@ -74,6 +117,29 @@ class MqttBroker:
             await self._server.serve_forever()
 
     # ---------------------------------------------------------- per-conn
+    async def _on_ws_connection(self, reader, writer):
+        """WS/WSS listener: upgrade, then serve the same MQTT handler
+        (rmqtt-net ws.rs equivalent). The upgrade itself is gated by the
+        overload check — slow-header floods must not bypass it."""
+        from rmqtt_tpu.broker.ws import WsReader, WsWriter, websocket_accept
+
+        ctx = self.ctx
+        if ctx.is_busy():
+            ctx.metrics.inc("handshake.refused_busy")
+            writer.close()
+            return
+        ctx.handshaking += 1
+        try:
+            ok = await websocket_accept(reader, writer)
+        finally:
+            ctx.handshaking -= 1
+        if not ok:
+            writer.close()
+            return
+        ws_writer = WsWriter(writer)
+        ws_reader = WsReader(reader, ws_writer)
+        await self._on_connection(ws_reader, ws_writer)
+
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         ctx = self.ctx
         peer = writer.get_extra_info("peername")

@@ -83,7 +83,8 @@ class Session:
         self.subscriptions: Dict[str, SubscriptionOptions] = {}
         self.deliver_queue: DeliverQueue[DeliverItem] = DeliverQueue(limits.max_mqueue)
         self.out_inflight = OutInflight(max_inflight=limits.max_inflight)
-        self.in_qos2 = InInflight()
+        # inbound QoS2 window = our advertised Receive Maximum (MQTT-5 3.3.4)
+        self.in_qos2 = InInflight(max_size=limits.max_inflight)
         self.connected = False
         self.state: Optional["SessionState"] = None
         self.will: Optional[pk.Will] = connect_info_will(connect_info)

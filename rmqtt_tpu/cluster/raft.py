@@ -56,6 +56,7 @@ class RaftNode:
         self._match_index: Dict[int, int] = {}
         self._last_heartbeat = 0.0
         self._tasks: List[asyncio.Task] = []
+        self._lead_task: Optional[asyncio.Task] = None
         self._commit_waiters: Dict[int, asyncio.Future] = {}
         self._apply_lock = asyncio.Lock()
         self._stopped = False
@@ -68,9 +69,13 @@ class RaftNode:
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in self._tasks:
+        tasks = list(self._tasks)
+        if self._lead_task is not None:
+            tasks.append(self._lead_task)
+            self._lead_task = None
+        for t in tasks:
             t.cancel()
-        for t in self._tasks:
+        for t in tasks:
             try:
                 await t
             except asyncio.CancelledError:
@@ -135,7 +140,9 @@ class RaftNode:
         self._next_index = {nid: nxt for nid in self.peers}
         self._match_index = {nid: 0 for nid in self.peers}
         log.info("raft node %s became leader (term %s)", self.node_id, self.term)
-        self._tasks.append(asyncio.get_running_loop().create_task(self._lead_loop()))
+        if self._lead_task is not None:
+            self._lead_task.cancel()
+        self._lead_task = asyncio.get_running_loop().create_task(self._lead_loop())
 
     def _step_down(self, term: int) -> None:
         if term > self.term:
@@ -244,9 +251,12 @@ class RaftNode:
                         timeout=max(0.1, deadline - asyncio.get_running_loop().time()),
                     )
                     if reply.get("ok"):
-                        # wait until the entry reaches *this* node's state
-                        await self._wait_applied(reply["index"], deadline)
-                        return True
+                        try:
+                            # wait until the entry reaches *this* node's state
+                            await self._wait_applied(reply["index"], deadline)
+                            return True
+                        except asyncio.TimeoutError:
+                            return False  # committed on the leader; local apply lags
                 except (PeerUnavailable, ClusterReplyError):
                     pass
             if asyncio.get_running_loop().time() >= deadline:

@@ -63,14 +63,22 @@ class RaftSessionRegistry(SessionRegistry):
             task.add_done_callback(c._bg_tasks.discard)
             raise ClusterReplyError("raft propose (add) failed")
 
+    def _retry_in_background(self, entry) -> None:
+        """Removals must eventually apply — retry with a long deadline when
+        consensus is briefly unavailable (no leader / partition)."""
+        c = self.cluster
+        task = asyncio.get_running_loop().create_task(c.raft.propose(entry, timeout=120.0))
+        c._bg_tasks.add(task)
+        task.add_done_callback(c._bg_tasks.discard)
+
     async def router_remove(self, stripped: str, id) -> None:
         c = self.cluster
         if c is None or not c.peers:
             self.ctx.router.remove(stripped, id)
             return
-        await c.raft.propose(
-            {"op": "remove", "tf": stripped, "node": id.node_id, "client": id.client_id}
-        )
+        entry = {"op": "remove", "tf": stripped, "node": id.node_id, "client": id.client_id}
+        if not await c.raft.propose(entry):
+            self._retry_in_background(entry)
 
     async def router_remove_many(self, items) -> None:
         """One consensus round for a whole session's removals (terminate)."""
@@ -79,10 +87,12 @@ class RaftSessionRegistry(SessionRegistry):
             for stripped, id in items:
                 self.ctx.router.remove(stripped, id)
             return
-        await c.raft.propose({
+        entry = {
             "op": "remove_many",
             "items": [[stripped, id.node_id, id.client_id] for stripped, id in items],
-        })
+        }
+        if not await c.raft.propose(entry):
+            self._retry_in_background(entry)
 
     async def forwards(self, msg: Message) -> int:
         c = self.cluster

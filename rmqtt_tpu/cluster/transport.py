@@ -215,24 +215,41 @@ class ClusterServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._conns.add(writer)
+        wlock = asyncio.Lock()
+        pending: set = set()
+
+        async def dispatch(frame: dict) -> None:
+            # handlers run concurrently: a slow handler (e.g. a raft-mode
+            # KICK that itself awaits consensus) must not stall heartbeats
+            # and votes multiplexed on the same peer connection
+            mtype, body, corr = frame.get("t"), frame.get("b"), frame.get("corr")
+            try:
+                reply = await self.handler(mtype, body, frame.get("node"))
+            except ClusterReplyError as e:  # expected, travels to caller
+                reply = {"__err": str(e)}
+            except Exception as e:  # handler bugs become error replies
+                log.exception("cluster handler error for %s", mtype)
+                reply = {"__err": str(e)}
+            if corr is not None:
+                try:
+                    async with wlock:
+                        writer.write(_frame({"corr": corr, "reply": reply}))
+                        await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+
         try:
             while True:
                 frame = await _read_frame(reader)
-                mtype, body, corr = frame.get("t"), frame.get("b"), frame.get("corr")
-                try:
-                    reply = await self.handler(mtype, body, frame.get("node"))
-                except ClusterReplyError as e:  # expected, travels to caller
-                    reply = {"__err": str(e)}
-                except Exception as e:  # handler bugs become error replies
-                    log.exception("cluster handler error for %s", mtype)
-                    reply = {"__err": str(e)}
-                if corr is not None:
-                    writer.write(_frame({"corr": corr, "reply": reply}))
-                    await writer.drain()
+                task = asyncio.get_running_loop().create_task(dispatch(frame))
+                pending.add(task)
+                task.add_done_callback(pending.discard)
         except (ConnectionError, asyncio.IncompleteReadError, ValueError):
             pass
         finally:
             self._conns.discard(writer)
+            for t in pending:
+                t.cancel()
             try:
                 writer.close()
             except Exception:

@@ -103,6 +103,11 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
     broker_kwargs: Dict[str, Any] = {
         "host": listener.get("host", "0.0.0.0"),
         "port": int(listener.get("port", 1883)),
+        "ws_port": int(listener["ws_port"]) if "ws_port" in listener else None,
+        "tls_port": int(listener["tls_port"]) if "tls_port" in listener else None,
+        "wss_port": int(listener["wss_port"]) if "wss_port" in listener else None,
+        "tls_cert": listener.get("tls_cert", ""),
+        "tls_key": listener.get("tls_key", ""),
         "node_id": int(node.get("id", 1)),
         "router": node.get("router", "trie"),
         "fitter": fitter,

@@ -1,0 +1,184 @@
+"""WS / TLS / WSS listener tests with real protocol clients."""
+
+import asyncio
+import base64
+import hashlib
+import os
+import ssl
+import struct
+import subprocess
+
+import pytest
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.broker.ws import OP_BIN, OP_CLOSE, OP_PING, mask_client_frame
+
+from tests.mqtt_client import TestClient
+
+
+def run_async(fn, timeout=30.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+class WsTestClient:
+    """Client-side WebSocket wrapper speaking MQTT over binary frames."""
+
+    def __init__(self, reader, writer) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.codec = MqttCodec()
+
+    @classmethod
+    async def connect(cls, port, client_id, sslctx=None):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port, ssl=sslctx)
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write(
+            (
+                f"GET /mqtt HTTP/1.1\r\nHost: localhost:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+                "Sec-WebSocket-Protocol: mqtt\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        resp = await reader.readuntil(b"\r\n\r\n")
+        assert b"101" in resp.split(b"\r\n")[0], resp
+        assert b"Sec-WebSocket-Protocol: mqtt" in resp
+        c = cls(reader, writer)
+        await c.send_packet(pk.Connect(client_id=client_id))
+        p = await c.recv_packet()
+        assert isinstance(p, pk.Connack) and p.reason_code == 0
+        return c
+
+    async def send_packet(self, p) -> None:
+        self.writer.write(mask_client_frame(OP_BIN, self.codec.encode(p)))
+        await self.writer.drain()
+
+    async def recv_frame(self):
+        head = await self.reader.readexactly(2)
+        op = head[0] & 0x0F
+        length = head[1] & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", await self.reader.readexactly(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", await self.reader.readexactly(8))
+        payload = await self.reader.readexactly(length) if length else b""
+        return op, payload
+
+    async def recv_packet(self):
+        while True:
+            op, payload = await self.recv_frame()
+            if op == OP_BIN:
+                packets = self.codec.feed(payload)
+                if packets:
+                    return packets[0]
+
+
+def test_ws_pubsub():
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, ws_port=0)))
+        await b.start()
+        ws = await WsTestClient.connect(b.ws_port, "ws-client")
+        # subscribe over WS
+        await ws.send_packet(pk.Subscribe(1, [("ws/#", pk.SubOpts(qos=1))]))
+        suback = await ws.recv_packet()
+        assert isinstance(suback, pk.Suback)
+        # publish from a plain TCP client; receive over WS
+        tcp = await TestClient.connect(b.port, "tcp-pub")
+        await tcp.publish("ws/topic", b"over-websocket", qos=1)
+        p = await ws.recv_packet()
+        assert isinstance(p, pk.Publish) and p.payload == b"over-websocket"
+        # publish over WS; receive on TCP
+        await tcp.subscribe("fromws/#", qos=0)
+        await ws.send_packet(pk.Publish(topic="fromws/x", payload=b"hi", qos=0))
+        p2 = await tcp.recv()
+        assert p2.payload == b"hi"
+        await b.stop()
+
+    run_async(run)
+
+
+def test_ws_ping_and_fragmentation_robustness():
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, ws_port=0)))
+        await b.start()
+        ws = await WsTestClient.connect(b.ws_port, "ws-frag")
+        # WS-level ping gets a pong
+        ws.writer.write(mask_client_frame(OP_PING, b"hello"))
+        await ws.writer.drain()
+        op, payload = await ws.recv_frame()
+        assert op == 0xA and payload == b"hello"
+        # an MQTT packet split across two WS frames (fragmented message)
+        data = ws.codec.encode(pk.Pingreq())
+        frame1 = mask_client_frame(OP_BIN, data[:1])
+        # continuation frame: opcode 0, FIN set — rebuild manually
+        frame1 = bytearray(frame1)
+        frame1[0] = 0x02  # FIN=0, opcode BIN
+        ws.writer.write(bytes(frame1))
+        cont = bytearray(mask_client_frame(0x0, data[1:]))
+        ws.writer.write(bytes(cont))
+        await ws.writer.drain()
+        p = await ws.recv_packet()
+        assert isinstance(p, pk.Pingresp)
+        await b.stop()
+
+    run_async(run)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    return str(cert), str(key)
+
+
+def test_tls_listener(certs):
+    cert, key = certs
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, tls_port=0, tls_cert=cert, tls_key=key,
+        )))
+        await b.start()
+        cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        cctx.check_hostname = False
+        cctx.verify_mode = ssl.CERT_NONE
+        reader, writer = await asyncio.open_connection("127.0.0.1", b.tls_port, ssl=cctx)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id="tls-c")))
+        await writer.drain()
+        data = await reader.read(64)
+        (connack,) = codec.feed(data)
+        assert isinstance(connack, pk.Connack) and connack.reason_code == 0
+        writer.close()
+        await b.stop()
+
+    run_async(run)
+
+
+def test_wss_listener(certs):
+    cert, key = certs
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, wss_port=0, tls_cert=cert, tls_key=key,
+        )))
+        await b.start()
+        cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        cctx.check_hostname = False
+        cctx.verify_mode = ssl.CERT_NONE
+        ws = await WsTestClient.connect(b.wss_port, "wss-client", sslctx=cctx)
+        await ws.send_packet(pk.Pingreq())
+        p = await ws.recv_packet()
+        assert isinstance(p, pk.Pingresp)
+        await b.stop()
+
+    run_async(run)
