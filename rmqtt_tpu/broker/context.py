@@ -47,6 +47,7 @@ class BrokerConfig:
     retain_max: int = 1_000_000
     delayed_publish_max: int = 100_000
     shared_subscription: bool = True
+    limit_subscription: bool = False  # enable $limit/$exclusive prefixes
     batch_max: int = 1024
     batch_linger_ms: float = 1.0
     cluster: bool = False  # use a cluster-aware session registry

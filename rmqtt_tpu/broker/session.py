@@ -42,6 +42,7 @@ from rmqtt_tpu.broker.types import (
 from rmqtt_tpu.core.topic import (
     InvalidSharedFilter,
     filter_valid,
+    parse_limit,
     parse_shared,
     split_levels,
     topic_valid,
@@ -521,7 +522,10 @@ class SessionState:
             if sids:
                 sub_id = int(sids[0])
         for tf, opts in p.filters:
-            codes.append(await self._subscribe_one(tf, opts, sub_id))
+            code = await self._subscribe_one(tf, opts, sub_id)
+            if self.codec.version != pk.V5 and code >= 0x80:
+                code = 0x80  # v3.1.1 SUBACK only knows 0x80 for failure
+            codes.append(code)
         await self.send(pk.Suback(p.packet_id, codes))
 
     async def _subscribe_one(self, topic_filter: str, opts: pk.SubOpts, sub_id) -> int:
@@ -529,7 +533,13 @@ class SessionState:
         s = self.s
         cfg = self.ctx.cfg
         try:
-            group, stripped = parse_shared(topic_filter)
+            if cfg.limit_subscription:
+                # $limit/$exclusive prefixes are an opt-in feature, like the
+                # reference's limit_subscription listener flag (types.rs:570+)
+                limit, unlimited = parse_limit(topic_filter)
+            else:
+                limit, unlimited = None, topic_filter
+            group, stripped = parse_shared(unlimited)
         except InvalidSharedFilter:
             return RC_TOPIC_FILTER_INVALID
         if group is not None and not cfg.shared_subscription:
@@ -567,8 +577,14 @@ class SessionState:
         )
         is_new = topic_filter not in s.subscriptions
         try:
-            await self.ctx.registry.subscribe(s, topic_filter, stripped, sopts)
-        except Exception:
+            await self.ctx.registry.subscribe(s, topic_filter, stripped, sopts, limit=limit)
+        except Exception as e:
+            from rmqtt_tpu.broker.shared import SubscriptionLimitExceeded
+
+            if isinstance(e, SubscriptionLimitExceeded):
+                from rmqtt_tpu.broker.types import RC_QUOTA_EXCEEDED
+
+                return RC_QUOTA_EXCEEDED
             # e.g. raft consensus unavailable (no leader / minority partition)
             self.ctx.metrics.inc("subscribe.errors")
             return RC_UNSPECIFIED_ERROR

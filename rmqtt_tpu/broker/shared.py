@@ -19,6 +19,10 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.router.base import Id, SubscriptionOptions
 
 
+class SubscriptionLimitExceeded(Exception):
+    """$limit/$exclusive cap reached for a filter."""
+
+
 class SessionRegistry:
     def __init__(self, ctx) -> None:
         self.ctx = ctx
@@ -74,12 +78,12 @@ class SessionRegistry:
         if cur is not session:
             return  # already replaced by a newer session
         del self._sessions[session.client_id]
-        from rmqtt_tpu.core.topic import parse_shared
+        from rmqtt_tpu.core.topic import strip_prefixes
 
         items = []
         for full_filter, opts in list(session.subscriptions.items()):
             try:
-                _, stripped = parse_shared(full_filter)
+                stripped = strip_prefixes(full_filter)
             except Exception:
                 stripped = full_filter
             items.append((stripped, session.id))
@@ -90,10 +94,20 @@ class SessionRegistry:
 
     # ------------------------------------------------------------ sub/unsub
     async def subscribe(
-        self, session: Session, full_filter: str, stripped: str, opts: SubscriptionOptions
+        self, session: Session, full_filter: str, stripped: str, opts: SubscriptionOptions,
+        limit: Optional[int] = None,
     ) -> None:
         """Router add + session bookkeeping (shared.rs:555-574). Async so
-        cluster modes can await consensus (raft proposals) before SUBACK."""
+        cluster modes can await consensus (raft proposals) before SUBACK.
+
+        ``limit`` enforces $limit/$exclusive immediately before the relation
+        insert — atomic on this node (no awaits in between); under raft the
+        replicated count still has a cross-node race window (PLAN.md).
+        """
+        if limit is not None and self.ctx.router.subscribers_count(
+            stripped, exclude_client=session.client_id
+        ) >= limit:
+            raise SubscriptionLimitExceeded(stripped)
         await self.router_add(stripped, session.id, opts)
         session.subscriptions[full_filter] = opts
 
@@ -109,13 +123,13 @@ class SessionRegistry:
             await self.router_remove(stripped, id)
 
     async def unsubscribe(self, session: Session, full_filter: str) -> bool:
-        from rmqtt_tpu.core.topic import parse_shared
+        from rmqtt_tpu.core.topic import strip_prefixes
 
         opts = session.subscriptions.pop(full_filter, None)
         if opts is None:
             return False
         try:
-            _, stripped = parse_shared(full_filter)
+            stripped = strip_prefixes(full_filter)
         except Exception:
             stripped = full_filter
         await self.router_remove(stripped, session.id)

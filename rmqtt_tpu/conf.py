@@ -172,6 +172,8 @@ PLUGIN_REGISTRY: Dict[str, str] = {
     "rmqtt-retainer": "rmqtt_tpu.plugins.retainer:RetainerPlugin",
     "rmqtt-bridge-ingress-mqtt": "rmqtt_tpu.plugins.bridge_mqtt:BridgeIngressMqttPlugin",
     "rmqtt-bridge-egress-mqtt": "rmqtt_tpu.plugins.bridge_mqtt:BridgeEgressMqttPlugin",
+    "rmqtt-bridge-ingress-nats": "rmqtt_tpu.plugins.bridge_nats:BridgeIngressNatsPlugin",
+    "rmqtt-bridge-egress-nats": "rmqtt_tpu.plugins.bridge_nats:BridgeEgressNatsPlugin",
 }
 
 

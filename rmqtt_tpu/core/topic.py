@@ -141,3 +141,40 @@ def parse_shared(topic_filter: str) -> Tuple[Optional[str], str]:
     if idx <= 0 or not rest[idx + 1 :]:
         raise InvalidSharedFilter(f"malformed shared subscription filter: {topic_filter!r}")
     return rest[:idx], rest[idx + 1 :]
+
+
+def parse_limit(topic_filter: str) -> Tuple[Optional[int], str]:
+    """Parse ``$limit/<n>/<filter>`` and ``$exclusive/<filter>`` prefixes.
+
+    The reference's limit-subscription feature
+    (rmqtt/src/types.rs parse_topic_filter: ``$limit`` caps the number of
+    subscribers for a filter; ``$exclusive`` is the 1-subscriber case).
+    Returns ``(None, topic_filter)`` when no prefix is present.
+    """
+    if topic_filter.startswith("$exclusive/"):  # see strip_prefixes below
+        rest = topic_filter[len("$exclusive/") :]
+        if not rest:
+            raise InvalidSharedFilter(f"malformed $exclusive filter: {topic_filter!r}")
+        return 1, rest
+    if topic_filter.startswith("$limit/"):
+        rest = topic_filter[len("$limit/") :]
+        idx = rest.find(SEP)
+        if idx <= 0 or not rest[idx + 1 :]:
+            raise InvalidSharedFilter(f"malformed $limit filter: {topic_filter!r}")
+        try:
+            n = int(rest[:idx])
+        except ValueError as e:
+            raise InvalidSharedFilter(f"malformed $limit count: {topic_filter!r}") from e
+        if n < 1:
+            raise InvalidSharedFilter(f"$limit count must be >= 1: {topic_filter!r}")
+        return n, rest[idx + 1 :]
+    return None, topic_filter
+
+
+def strip_prefixes(topic_filter: str) -> str:
+    """Stripped routing filter: removes ``$limit``/``$exclusive`` and
+    ``$share`` prefixes (the filter actually stored in the router). Raises
+    :class:`InvalidSharedFilter` on malformed prefixes."""
+    _limit, rest = parse_limit(topic_filter)
+    _group, stripped = parse_shared(rest)
+    return stripped

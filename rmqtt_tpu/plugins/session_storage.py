@@ -24,7 +24,7 @@ from rmqtt_tpu.cluster.messages import (
     opts_from_wire,
     opts_to_wire,
 )
-from rmqtt_tpu.core.topic import parse_shared
+from rmqtt_tpu.core.topic import strip_prefixes
 from rmqtt_tpu.plugins import Plugin
 from rmqtt_tpu.router.base import Id
 
@@ -118,7 +118,7 @@ class SessionStoragePlugin(Plugin):
             for tf, ow in snap["subs"]:
                 opts = opts_from_wire(ow)
                 try:
-                    _group, stripped = parse_shared(tf)
+                    stripped = strip_prefixes(tf)
                 except ValueError:
                     stripped = tf
                 await ctx.registry.subscribe(session, tf, stripped, opts)

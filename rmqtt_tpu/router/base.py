@@ -137,3 +137,10 @@ class Router(abc.ABC):
     @abc.abstractmethod
     def is_match(self, topic: str) -> bool:
         """Does any subscription match this topic?"""
+
+    @abc.abstractmethod
+    def subscribers_count(self, topic_filter: str, exclude_client: Optional[str] = None) -> int:
+        """Current subscriber count for one exact filter ($limit/$exclusive
+        enforcement; cluster-wide under raft's replicated table). When
+        ``exclude_client`` is given, that client's own relation is not
+        counted (re-subscribing must not trip the cap, session.rs:1292-1306)."""

@@ -77,6 +77,13 @@ class NativeRouter(Router):
                 out.append({"topic_filter": tf, "client_id": cid})
         return out
 
+    def subscribers_count(self, topic_filter: str, exclude_client=None) -> int:
+        rels = self._relations.get(topic_filter)
+        n = len(rels)
+        if exclude_client is not None and exclude_client in rels:
+            n -= 1
+        return n
+
     def topics_count(self) -> int:
         return len(self._relations)
 

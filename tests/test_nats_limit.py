@@ -1,0 +1,194 @@
+"""NATS bridge tests (against a protocol-accurate mini NATS server) and
+$limit / $exclusive subscription enforcement."""
+
+import asyncio
+import json
+
+import pytest
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.core.topic import InvalidSharedFilter, parse_limit
+
+from tests.mqtt_client import TestClient
+
+
+def run_async(fn, timeout=30.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+class MiniNatsServer:
+    """Tiny NATS server honoring INFO/CONNECT/SUB/PUB/MSG/PING (docs.nats.io)."""
+
+    def __init__(self) -> None:
+        self._server = None
+        self.subs = []  # (writer, subject, sid)
+        self.published = []  # (subject, payload)
+        self._conns = set()
+
+    @property
+    def port(self):
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+
+    async def stop(self):
+        self._server.close()
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:
+                pass
+        await self._server.wait_closed()
+
+    def _matches(self, pattern: str, subject: str) -> bool:
+        pp, ss = pattern.split("."), subject.split(".")
+        for i, tok in enumerate(pp):
+            if tok == ">":
+                return True
+            if i >= len(ss):
+                return False
+            if tok != "*" and tok != ss[i]:
+                return False
+        return len(pp) == len(ss)
+
+    async def _on_conn(self, reader, writer):
+        self._conns.add(writer)
+        writer.write(b'INFO {"server_id":"mini","version":"0.0"}\r\n')
+        await writer.drain()
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                if line.startswith(b"CONNECT"):
+                    continue
+                if line.startswith(b"PING"):
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
+                elif line.startswith(b"SUB"):
+                    parts = line.decode().split()
+                    subject, sid = parts[1], parts[-1]
+                    self.subs.append((writer, subject, sid))
+                elif line.startswith(b"PUB"):
+                    parts = line.decode().split()
+                    subject, nbytes = parts[1], int(parts[-1])
+                    payload = await reader.readexactly(nbytes)
+                    await reader.readexactly(2)
+                    self.published.append((subject, payload))
+                    for w, pattern, sid in self.subs:
+                        if self._matches(pattern, subject):
+                            w.write(
+                                f"MSG {subject} {sid} {len(payload)}\r\n".encode()
+                                + payload + b"\r\n"
+                            )
+                            await w.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+
+
+def test_nats_bridge_roundtrip():
+    from rmqtt_tpu.plugins.bridge_nats import (
+        BridgeEgressNatsPlugin,
+        BridgeIngressNatsPlugin,
+    )
+
+    async def run():
+        nats = MiniNatsServer()
+        await nats.start()
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(BridgeIngressNatsPlugin(b.ctx, {
+            "host": "127.0.0.1", "port": nats.port,
+            "subscribes": ["from-nats/#"], "local_prefix": "nats/",
+        }))
+        b.ctx.plugins.register(BridgeEgressNatsPlugin(b.ctx, {
+            "host": "127.0.0.1", "port": nats.port,
+            "forwards": ["to-nats/#"],
+        }))
+        await b.start()
+        for p in b.ctx.plugins._plugins.values():
+            await asyncio.wait_for(p._client.connected.wait(), 5.0)
+        await asyncio.sleep(0.1)  # let SUB reach the server
+
+        # ingress: NATS message → local MQTT subscriber
+        sub = await TestClient.connect(b.port, "n-sub")
+        await sub.subscribe("nats/#", qos=0)
+        # publish on the NATS side through a raw connection
+        r, w = await asyncio.open_connection("127.0.0.1", nats.port)
+        await r.readline()  # INFO
+        w.write(b"CONNECT {}\r\npub from-nats.sensors.one 5\r\n".replace(b"pub", b"PUB") )
+        w.write(b"hello\r\n")
+        await w.drain()
+        p = await sub.recv()
+        assert p.topic == "nats/from-nats/sensors/one" and p.payload == b"hello"
+
+        # egress: local publish → NATS subject
+        pub = await TestClient.connect(b.port, "n-pub")
+        await pub.publish("to-nats/x/y", b"out", qos=1)
+        await asyncio.sleep(0.3)
+        assert ("to-nats.x.y", b"out") in nats.published
+        await b.stop()
+        await nats.stop()
+
+    run_async(run)
+
+
+def test_parse_limit():
+    assert parse_limit("$exclusive/a/b") == (1, "a/b")
+    assert parse_limit("$limit/5/a/#") == (5, "a/#")
+    assert parse_limit("plain/t") == (None, "plain/t")
+    for bad in ["$exclusive/", "$limit/x/t", "$limit/0/t", "$limit/5", "$limit//t"]:
+        with pytest.raises(InvalidSharedFilter):
+            parse_limit(bad)
+
+
+def test_exclusive_subscription_enforced():
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, limit_subscription=True)))
+        await b.start()
+        c1 = await TestClient.connect(b.port, "ex1", version=pk.V5)
+        ack = await c1.subscribe("$exclusive/solo/t", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        c2 = await TestClient.connect(b.port, "ex2", version=pk.V5)
+        ack2 = await c2.subscribe("$exclusive/solo/t", qos=1)
+        assert ack2.reason_codes[0] == 0x97  # quota exceeded
+        # delivery reaches the exclusive holder on the stripped topic
+        pub = await TestClient.connect(b.port, "ex-pub")
+        await pub.publish("solo/t", b"only-one", qos=1)
+        p = await c1.recv()
+        assert p.payload == b"only-one"
+        # holder leaves → the seat frees up
+        await c1.disconnect_clean()
+        await asyncio.sleep(0.1)
+        ack3 = await c2.subscribe("$exclusive/solo/t", qos=1)
+        assert ack3.reason_codes[0] < 0x80
+        await b.stop()
+
+    run_async(run)
+
+
+def test_limit_subscription_enforced():
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, limit_subscription=True)))
+        await b.start()
+        acks = []
+        clients = []
+        for i in range(3):
+            c = await TestClient.connect(b.port, f"lim{i}", version=pk.V5)
+            clients.append(c)
+            ack = await c.subscribe("$limit/2/capped/t", qos=1)
+            acks.append(ack.reason_codes[0])
+        assert acks[0] < 0x80 and acks[1] < 0x80 and acks[2] == 0x97
+        # re-subscribing must not trip the cap (self-exclusion)
+        again = await clients[0].subscribe("$limit/2/capped/t", qos=1)
+        assert again.reason_codes[0] < 0x80
+        # v3 client gets 0x80, not 0x97
+        v3c = await TestClient.connect(b.port, "limv3")
+        ack3 = await v3c.subscribe("$limit/2/capped/t", qos=1)
+        assert ack3.reason_codes[0] == 0x80
+        # without the feature flag the prefix is a literal filter
+        await b.stop()
+
+    run_async(run)
