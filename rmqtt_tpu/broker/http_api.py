@@ -19,11 +19,56 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from rmqtt_tpu import __version__
 from rmqtt_tpu.broker.types import Message, now
+from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.router.base import Id
 
 log = logging.getLogger("rmqtt_tpu.http")
 
 _STARTED_AT = time.time()
+
+
+def client_info(s) -> dict:
+    """Serialized client/session row (api.rs clients payload shape)."""
+    return {
+        "clientid": s.client_id,
+        "node_id": s.id.node_id,
+        "connected": s.connected,
+        "protocol": s.connect_info.protocol,
+        "username": s.connect_info.username,
+        "keepalive": s.limits.keepalive,
+        "clean_start": s.clean_start,
+        "session_expiry": s.limits.session_expiry,
+        "subscriptions": len(s.subscriptions),
+        "mqueue_len": len(s.deliver_queue),
+        "inflight": len(s.out_inflight),
+        "created_at": s.created_at,
+        "ip": s.connect_info.remote_addr[0] if s.connect_info.remote_addr else None,
+    }
+
+
+def subscription_rows(ctx, limit: int) -> list:
+    out = []
+    for s in ctx.registry.sessions():
+        for tf, opts in s.subscriptions.items():
+            if len(out) >= limit:
+                return out
+            out.append({
+                "client_id": s.client_id, "node_id": s.id.node_id,
+                "topic_filter": tf, "qos": opts.qos, "share": opts.shared_group,
+            })
+    return out
+
+
+async def _cluster_merge(ctx, mtype: str, body, extract) -> list:
+    """Fan an admin query out to peers and merge rows (the reference's
+    http-api gRPC broadcast, rmqtt-http-api/src/handler.rs)."""
+    cluster = getattr(ctx.registry, "cluster", None)
+    rows: list = []
+    if cluster is not None and cluster.peers:
+        for _nid, reply in await cluster.bcast.join_all_call(mtype, body):
+            if not isinstance(reply, Exception):
+                rows.extend(extract(reply))
+    return rows
 
 
 class HttpApi:
@@ -117,9 +162,11 @@ class HttpApi:
             return 200, {"status": "ok", "node_id": ctx.node_id}, J
         if path == "/api/v1/clients":
             limit = int(q.get("_limit", ["100"])[0])
-            return 200, [
-                self._client_info(s) for s in list(ctx.registry.sessions())[:limit]
-            ], J
+            rows = [client_info(s) for s in list(ctx.registry.sessions())[:limit]]
+            rows += await _cluster_merge(
+                ctx, M.CLIENTS_GET, {"limit": limit}, lambda r: r.get("clients", [])
+            )
+            return 200, rows[: limit], J
         if path.startswith("/api/v1/clients/"):
             cid = path.rsplit("/", 1)[1]
             s = ctx.registry.get(cid)
@@ -131,24 +178,24 @@ class HttpApi:
                 else:
                     await ctx.registry.terminate(s, "api-kick")
                 return 200, {"kicked": cid}, J
-            return 200, self._client_info(s), J
+            return 200, client_info(s), J
         if path == "/api/v1/subscriptions":
             limit = int(q.get("_limit", ["100"])[0])
-            out = []
-            for s in ctx.registry.sessions():
-                for tf, opts in s.subscriptions.items():
-                    if len(out) >= limit:
-                        break
-                    out.append({
-                        "client_id": s.client_id, "topic_filter": tf,
-                        "qos": opts.qos, "share": opts.shared_group,
-                    })
-            return 200, out, J
+            rows = subscription_rows(ctx, limit)
+            rows += await _cluster_merge(
+                ctx, M.SUBSCRIPTIONS_GET, {"limit": limit},
+                lambda r: r.get("subscriptions", []),
+            )
+            return 200, rows[: limit], J
         if path == "/api/v1/routes":
             limit = int(q.get("_limit", ["100"])[0])
             return 200, ctx.router.gets(limit), J
         if path == "/api/v1/stats":
-            return 200, {"node": ctx.node_id, "stats": ctx.stats().to_json()}, J
+            nodes = [{"node": ctx.node_id, "stats": ctx.stats().to_json()}]
+            nodes += await _cluster_merge(
+                ctx, M.STATS_GET, {}, lambda r: [r] if "stats" in r else []
+            )
+            return 200, nodes, J
         if path == "/api/v1/metrics":
             return 200, {"node": ctx.node_id, "metrics": ctx.metrics.to_json()}, J
         if path == "/api/v1/plugins":
@@ -217,23 +264,6 @@ class HttpApi:
             "retaineds": stats.retaineds,
             "version": __version__,
             "uptime": round(time.time() - _STARTED_AT, 1),
-        }
-
-    def _client_info(self, s) -> dict:
-        return {
-            "clientid": s.client_id,
-            "node_id": s.id.node_id,
-            "connected": s.connected,
-            "protocol": s.connect_info.protocol,
-            "username": s.connect_info.username,
-            "keepalive": s.limits.keepalive,
-            "clean_start": s.clean_start,
-            "session_expiry": s.limits.session_expiry,
-            "subscriptions": len(s.subscriptions),
-            "mqueue_len": len(s.deliver_queue),
-            "inflight": len(s.out_inflight),
-            "created_at": s.created_at,
-            "ip": s.connect_info.remote_addr[0] if s.connect_info.remote_addr else None,
         }
 
     def _prometheus(self) -> str:

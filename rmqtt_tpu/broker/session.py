@@ -182,6 +182,86 @@ def connect_info_will(ci: ConnectInfo) -> Optional[pk.Will]:
     return ci.will
 
 
+def session_snapshot(s: Session, max_queue_items: Optional[int] = None) -> dict:
+    """Serializable session state: identity, limits, subscriptions, queued
+    AND unacked in-flight messages (the reference's SessionStateTransfer
+    payload carries both, session.rs:1374-1427 + OfflineInfo inflight).
+    Used by session-storage persistence and cross-node takeover transfer.
+    ``max_queue_items`` caps the payload for wire transfer only."""
+    from rmqtt_tpu.cluster.messages import msg_to_wire, opts_to_wire
+
+    items = []
+    # unacked QoS1/2 go first, flagged DUP for redelivery; QoS2 already
+    # PUBREC'd (UNCOMPLETE) would duplicate if replayed — dropped, as the
+    # new connection cannot resume the old packet-id handshake
+    for e in s.out_inflight.drain():
+        if e.status is not MomentStatus.UNCOMPLETE:
+            items.append([e.qos, False, "", list(e.subscription_ids), msg_to_wire(e.msg), True])
+    for it in s.deliver_queue._q:
+        items.append([it.qos, it.retain, it.topic_filter, list(it.sub_ids), msg_to_wire(it.msg), it.dup])
+    if max_queue_items is not None:
+        items = items[:max_queue_items]
+    return {
+        "client_id": s.client_id,
+        "node_id": s.id.node_id,
+        "clean_start": s.clean_start,
+        "created_at": s.created_at,
+        "session_expiry": s.limits.session_expiry,
+        "disconnected_at": time.time(),
+        "max_inflight": s.limits.max_inflight,
+        "max_mqueue": s.limits.max_mqueue,
+        "protocol": s.connect_info.protocol,
+        "keepalive": s.connect_info.keepalive,
+        "subs": [[tf, opts_to_wire(o)] for tf, o in s.subscriptions.items()],
+        "queue": items,
+    }
+
+
+async def restore_session(ctx, snap: dict, node_id: Optional[int] = None) -> Optional[Session]:
+    """Rebuild an OFFLINE session from a snapshot (offline_restart,
+    session.rs:516-558): re-registers subscriptions (under ``node_id`` if
+    given — the takeover-transfer case re-homes them) and refills the queue.
+    Returns None if the snapshot already expired."""
+    from rmqtt_tpu.cluster.messages import msg_from_wire, opts_from_wire
+    from rmqtt_tpu.core.topic import strip_prefixes
+
+    remaining = snap["session_expiry"] - (time.time() - snap["disconnected_at"])
+    if remaining <= 0:
+        return None
+    sid = Id(node_id if node_id is not None else snap["node_id"], snap["client_id"])
+    ci = ConnectInfo(
+        id=sid, protocol=snap["protocol"], keepalive=snap["keepalive"], clean_start=False
+    )
+    limits = Limits(
+        keepalive=snap["keepalive"], server_keepalive=False,
+        max_inflight=snap["max_inflight"], max_mqueue=snap["max_mqueue"],
+        session_expiry=remaining,
+        max_message_expiry=ctx.cfg.fitter.max_message_expiry,
+        max_topic_aliases_in=0, max_topic_aliases_out=0,
+        max_packet_size=ctx.cfg.max_packet_size,
+    )
+    session = Session(ctx, sid, ci, limits, clean_start=False)
+    ctx.registry._sessions[snap["client_id"]] = session
+    for tf, ow in snap["subs"]:
+        opts = opts_from_wire(ow)
+        try:
+            stripped = strip_prefixes(tf)
+        except ValueError:
+            stripped = tf
+        await ctx.registry.subscribe(session, tf, stripped, opts)
+    for row in snap["queue"]:
+        qos, retain, tf, sub_ids, mw = row[:5]
+        dup = bool(row[5]) if len(row) > 5 else False
+        msg = msg_from_wire(mw)
+        if not msg.is_expired():
+            session.deliver_queue.push(
+                DeliverItem(msg=msg, qos=qos, retain=retain,
+                            topic_filter=tf, sub_ids=tuple(sub_ids), dup=dup)
+            )
+    session._expiry_task = asyncio.get_running_loop().create_task(session._expire(remaining))
+    return session
+
+
 class SessionState:
     """The online half: socket ↔ session (session.rs run_loop :308-402)."""
 

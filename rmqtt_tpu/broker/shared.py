@@ -78,6 +78,19 @@ class SessionRegistry:
         if cur is not session:
             return  # already replaced by a newer session
         del self._sessions[session.client_id]
+        # drop the expiry timer so the dead session object is not pinned in
+        # memory for the rest of its expiry window (transfer/kick paths)
+        current = asyncio.current_task()
+        t = session._expiry_task
+        if t is not None and t is not current:
+            t.cancel()
+        session._expiry_task = None
+        if reason == "cluster-kick":
+            # the client reconnected elsewhere: a pending delayed will from
+            # the earlier abnormal disconnect must not fire
+            if session._will_task is not None and session._will_task is not current:
+                session._will_task.cancel()
+            session._will_task = None
         from rmqtt_tpu.core.topic import strip_prefixes
 
         items = []

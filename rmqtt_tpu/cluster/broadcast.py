@@ -63,11 +63,17 @@ async def handle_common_message(ctx, mtype: str, body) -> object:
                     if not session.connected:
                         break
                     await asyncio.sleep(0.01)
-            # the session now lives on the caller's node; drop the local
-            # copy entirely (cross-node offline-state transfer is not
-            # implemented yet)
+            # resumable session + resuming client: hand the state to the new
+            # owner node (the reference's SessionStateTransfer,
+            # session.rs:1374-1427) before dropping the local copy
+            state = None
+            if not body.get("clean_start", True) and session.limits.session_expiry > 0:
+                from rmqtt_tpu.broker.session import session_snapshot
+
+                # cap for the RPC frame; persistence paths snapshot uncapped
+                state = session_snapshot(session, max_queue_items=5000)
             await ctx.registry.terminate(session, "cluster-kick")
-            return {"kicked": True}
+            return {"kicked": True, "state": state}
         return {"kicked": False}
     if mtype == M.GET_RETAINS:
         filt = body.get("filter", "#")
@@ -92,9 +98,54 @@ async def handle_common_message(ctx, mtype: str, body) -> object:
         if s is None:
             return {"exists": False}
         return {"exists": True, "online": s.connected, "subs": len(s.subscriptions)}
+    if mtype == M.SUBSCRIPTIONS_GET:
+        from rmqtt_tpu.broker.http_api import subscription_rows
+
+        return {"subscriptions": subscription_rows(ctx, int(body.get("limit", 100)))}
+    if mtype == M.CLIENTS_GET:
+        from rmqtt_tpu.broker.http_api import client_info
+
+        limit = int(body.get("limit", 100))
+        return {"clients": [client_info(s) for s in list(ctx.registry.sessions())[:limit]]}
+    if mtype == M.STATS_GET:
+        return {"node": ctx.node_id, "stats": ctx.stats().to_json()}
     if mtype == M.PING:
         return {"pong": True}
     return _UNHANDLED
+
+
+class ClusterRegistryBase(SessionRegistry):
+    """Shared cluster-registry behavior: the cross-node kick + session-state
+    transfer protocol used by both broadcast and raft modes."""
+
+    def __init__(self, ctx) -> None:
+        super().__init__(ctx)
+        self.cluster = None
+
+    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
+        # tell peers to drop any session with this id and WAIT for their
+        # confirmation (broadcast-mode kick, src/lib.rs:179-200); a resumable
+        # session's state comes back in the reply and is rebuilt locally
+        # (the reference's SessionStateTransfer)
+        if self.cluster is not None and self.cluster.peers:
+            replies = await self.cluster.bcast.join_all_call(
+                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
+            )
+            await self._restore_transferred(ctx, id, clean_start, replies)
+        return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
+
+    async def _restore_transferred(self, ctx, id, clean_start: bool, replies) -> None:
+        if clean_start or ctx.registry.get(id.client_id) is not None:
+            return
+        for _nid, reply in replies:
+            if isinstance(reply, Exception) or not isinstance(reply, dict):
+                continue
+            snap = reply.get("state")
+            if snap:
+                from rmqtt_tpu.broker.session import restore_session
+
+                await restore_session(ctx, snap, node_id=id.node_id)
+                return
 
 
 def _cands_to_wire(shared) -> list:
@@ -114,12 +165,8 @@ def _cands_from_wire(rows) -> Dict[Tuple[str, str], list]:
     return out
 
 
-class ClusterSessionRegistry(SessionRegistry):
+class ClusterSessionRegistry(ClusterRegistryBase):
     """Registry whose fan-out scatter-gathers across the cluster."""
-
-    def __init__(self, ctx) -> None:
-        super().__init__(ctx)
-        self.cluster: Optional["BroadcastCluster"] = None
 
     async def forwards(self, msg: Message) -> int:
         cluster = self.cluster
@@ -185,19 +232,6 @@ class ClusterSessionRegistry(SessionRegistry):
             for rel in rels:
                 count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
         return count
-
-    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
-        # cross-node kick: tell peers to drop any session with this id and
-        # WAIT for their confirmation before going live, so the old copy is
-        # dead before the new session exists (broadcast-mode kick,
-        # src/lib.rs:179-200; errors are tolerated — a down peer can't hold
-        # a live session anyway)
-        if self.cluster is not None and self.cluster.peers:
-            await self.cluster.bcast.join_all_call(
-                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
-            )
-        return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
-
 
 class BroadcastCluster:
     def __init__(

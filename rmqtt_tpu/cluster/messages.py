@@ -25,6 +25,8 @@ NUMBER_OF_SESSIONS = "number_of_sessions"
 ONLINE = "online"
 SESSION_STATUS = "session_status"
 SUBSCRIPTIONS_GET = "subscriptions_get"
+CLIENTS_GET = "clients_get"
+STATS_GET = "stats_get"
 ROUTES_GET = "routes_get"
 PING = "ping"
 DATA = "data"

@@ -19,7 +19,11 @@ from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.cluster import messages as M
-from rmqtt_tpu.cluster.broadcast import _UNHANDLED, handle_common_message
+from rmqtt_tpu.cluster.broadcast import (
+    _UNHANDLED,
+    ClusterRegistryBase,
+    handle_common_message,
+)
 from rmqtt_tpu.cluster.raft import RAFT_APPEND, RAFT_PROPOSE, RAFT_VOTE, RaftNode
 from rmqtt_tpu.cluster.transport import (
     Broadcaster,
@@ -33,13 +37,9 @@ from rmqtt_tpu.router.base import Id, SubRelation
 log = logging.getLogger("rmqtt_tpu.cluster.raft")
 
 
-class RaftSessionRegistry(SessionRegistry):
+class RaftSessionRegistry(ClusterRegistryBase):
     """Registry whose router mutations go through Raft and whose fan-out
     sends targeted ForwardsTo to subscriber-owning nodes."""
-
-    def __init__(self, ctx) -> None:
-        super().__init__(ctx)
-        self.cluster: Optional["RaftCluster"] = None
 
     # subscription writes → consensus (router.rs:146-196)
     async def router_add(self, stripped: str, id, opts) -> None:
@@ -150,13 +150,6 @@ class RaftSessionRegistry(SessionRegistry):
             except PeerUnavailable:
                 log.warning("raft ForwardsTo to node %s failed", node_id)
         return count
-
-    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
-        if self.cluster is not None and self.cluster.peers:
-            await self.cluster.bcast.join_all_call(
-                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
-            )
-        return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
 
 
 class RaftCluster:

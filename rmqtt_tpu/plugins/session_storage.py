@@ -14,19 +14,9 @@ import asyncio
 import time
 from typing import Optional
 
-from rmqtt_tpu.broker.fitter import Limits
 from rmqtt_tpu.broker.hooks import HookType
-from rmqtt_tpu.broker.session import DeliverItem, Session
-from rmqtt_tpu.broker.types import ConnectInfo, Message
-from rmqtt_tpu.cluster.messages import (
-    msg_from_wire,
-    msg_to_wire,
-    opts_from_wire,
-    opts_to_wire,
-)
-from rmqtt_tpu.core.topic import strip_prefixes
+from rmqtt_tpu.broker.session import Session, restore_session, session_snapshot
 from rmqtt_tpu.plugins import Plugin
-from rmqtt_tpu.router.base import Id
 
 NS = "session"
 
@@ -43,23 +33,7 @@ class SessionStoragePlugin(Plugin):
         self._unhooks = []
 
     def _snapshot(self, s: Session) -> dict:
-        return {
-            "client_id": s.client_id,
-            "node_id": s.id.node_id,
-            "clean_start": s.clean_start,
-            "created_at": s.created_at,
-            "session_expiry": s.limits.session_expiry,
-            "disconnected_at": time.time(),
-            "max_inflight": s.limits.max_inflight,
-            "max_mqueue": s.limits.max_mqueue,
-            "protocol": s.connect_info.protocol,
-            "keepalive": s.connect_info.keepalive,
-            "subs": [[tf, opts_to_wire(o)] for tf, o in s.subscriptions.items()],
-            "queue": [
-                [it.qos, it.retain, it.topic_filter, list(it.sub_ids), msg_to_wire(it.msg)]
-                for it in list(s.deliver_queue._q)
-            ],
-        }
+        return session_snapshot(s)
 
     async def init(self) -> None:
         hooks = self.ctx.hooks
@@ -92,47 +66,11 @@ class SessionStoragePlugin(Plugin):
     async def start(self) -> None:
         """Rebuild persisted offline sessions (offline_restart)."""
         ctx = self.ctx
-        now = time.time()
         for client_id, snap in self.store.scan(NS):
             if ctx.registry.get(client_id) is not None:
                 continue
-            remaining = snap["session_expiry"] - (now - snap["disconnected_at"])
-            if remaining <= 0:
+            if await restore_session(ctx, snap) is None:
                 self.store.delete(NS, client_id)
-                continue
-            id = Id(snap["node_id"], client_id)
-            ci = ConnectInfo(
-                id=id, protocol=snap["protocol"], keepalive=snap["keepalive"],
-                clean_start=False,
-            )
-            limits = Limits(
-                keepalive=snap["keepalive"], server_keepalive=False,
-                max_inflight=snap["max_inflight"], max_mqueue=snap["max_mqueue"],
-                session_expiry=remaining,
-                max_message_expiry=ctx.cfg.fitter.max_message_expiry,
-                max_topic_aliases_in=0, max_topic_aliases_out=0,
-                max_packet_size=ctx.cfg.max_packet_size,
-            )
-            session = Session(ctx, id, ci, limits, clean_start=False)
-            ctx.registry._sessions[client_id] = session
-            for tf, ow in snap["subs"]:
-                opts = opts_from_wire(ow)
-                try:
-                    stripped = strip_prefixes(tf)
-                except ValueError:
-                    stripped = tf
-                await ctx.registry.subscribe(session, tf, stripped, opts)
-            for qos, retain, tf, sub_ids, mw in snap["queue"]:
-                msg = msg_from_wire(mw)
-                if not msg.is_expired():
-                    session.deliver_queue.push(
-                        DeliverItem(msg=msg, qos=qos, retain=retain,
-                                    topic_filter=tf, sub_ids=tuple(sub_ids))
-                    )
-            # arm the expiry timer (offline loop)
-            session._expiry_task = asyncio.get_running_loop().create_task(
-                session._expire(remaining)
-            )
 
     async def stop(self) -> bool:
         for un in self._unhooks:

@@ -168,3 +168,37 @@ async def test_peer_down_does_not_break_local(brokers, clusters):
     await pub.publish("l/t", b"still-works", qos=1)
     p = await sub.recv()
     assert p.payload == b"still-works"
+
+
+@cluster_test(2)
+async def test_session_state_transfer_across_nodes(brokers, clusters):
+    """Roaming client: persistent session moves node 1 → node 2 with
+    subscriptions AND queued messages (the reference's SessionStateTransfer)."""
+    from rmqtt_tpu.broker.codec import props as P
+
+    b1, b2 = brokers
+    c1 = await TestClient.connect(
+        b1.port, "roam-p", version=pk.V5,
+        properties={P.SESSION_EXPIRY_INTERVAL: 300},
+    )
+    await c1.subscribe("roam/t", qos=1)
+    await c1.disconnect_clean()
+    await asyncio.sleep(0.05)
+    # publish while the client is away: queues on node 1's offline session
+    pub = await TestClient.connect(b2.port, "roam-pub")
+    await pub.publish("roam/t", b"catch-me", qos=1)
+    await asyncio.sleep(0.1)
+    # the client reconnects on NODE 2 with clean_start=False
+    c2 = await TestClient.connect(
+        b2.port, "roam-p", version=pk.V5, clean_start=False,
+        properties={P.SESSION_EXPIRY_INTERVAL: 300},
+    )
+    assert c2.connack.session_present
+    p = await c2.recv()
+    assert p.payload == b"catch-me"
+    # subscription moved with the session: new publishes reach node 2
+    await pub.publish("roam/t", b"after-move", qos=1)
+    p = await c2.recv()
+    assert p.payload == b"after-move"
+    # node 1 no longer holds a copy
+    assert b1.ctx.registry.get("roam-p") is None

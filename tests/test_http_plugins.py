@@ -90,7 +90,7 @@ async def test_api_surface(broker, api):
     status, body = await http_get(api.bound_port, "/api/v1/subscriptions")
     assert json.loads(body)[0]["topic_filter"] == "api/+"
     status, body = await http_get(api.bound_port, "/api/v1/stats")
-    assert json.loads(body)["stats"]["connections"] == 1
+    assert json.loads(body)[0]["stats"]["connections"] == 1
     status, body = await http_get(api.bound_port, "/api/v1/metrics")
     assert "connections.established" in json.loads(body)["metrics"]
     status, body = await http_get(api.bound_port, "/api/v1/health")
