@@ -45,7 +45,21 @@ _K_HASH = ("#",)
 
 
 def partition_key(levels: Sequence[str]) -> Tuple:
-    """Partition of a (stripped, validated) filter; see module docstring."""
+    """Partition of a (stripped, validated) filter.
+
+    Depth-3 keys: measurement showed the depth-2 wildcard-wildcard bucket
+    dominates candidate counts (NOTES.md), so filters deep enough are split
+    by their third level too:
+
+    - ``("#",)``            bare ``#``
+    - ``("1", k0)``         single-level filters
+    - ``("2", k0)``         ``k0/#``
+    - ``("2E", k0, k1)``    exactly two levels, no ``#``
+    - ``("H3", k0, k1)``    ``k0/k1/#``
+    - ``("4", k0, k1, k2)`` three or more levels (k2 = third level)
+
+    with every ``k`` ∈ {token, ``+``}.
+    """
     f0 = levels[0]
     if f0 == HASH:
         return _K_HASH
@@ -54,20 +68,35 @@ def partition_key(levels: Sequence[str]) -> Tuple:
         return ("1", k0)
     if levels[1] == HASH:
         return ("2", k0)
-    f1 = levels[1]
-    k1 = PLUS if f1 == PLUS else f1
-    return ("3", k0, k1)
+    k1 = PLUS if levels[1] == PLUS else levels[1]
+    if len(levels) == 2:
+        return ("2E", k0, k1)
+    if levels[2] == HASH:
+        return ("H3", k0, k1)
+    k2 = PLUS if levels[2] == PLUS else levels[2]
+    return ("4", k0, k1, k2)
 
 
 def topic_partitions(levels: Sequence[str]) -> List[Tuple]:
-    """Candidate partitions for a publish topic (≤7)."""
+    """Candidate partitions for a publish topic (≤15, most tiny)."""
     t0 = levels[0]
+    n = len(levels)
     out: List[Tuple] = [_K_HASH, ("2", t0), ("2", PLUS)]
-    if len(levels) == 1:
+    if n == 1:
         out += [("1", t0), ("1", PLUS)]
-    else:
-        t1 = levels[1]
-        out += [("3", t0, t1), ("3", t0, PLUS), ("3", PLUS, t1), ("3", PLUS, PLUS)]
+        return out
+    t1 = levels[1]
+    pairs = ((t0, t1), (t0, PLUS), (PLUS, t1), (PLUS, PLUS))
+    for a, b in pairs:
+        out.append(("H3", a, b))
+    if n == 2:
+        for a, b in pairs:
+            out.append(("2E", a, b))
+        return out
+    t2 = levels[2]
+    for a, b in pairs:
+        out.append(("4", a, b, t2))
+        out.append(("4", a, b, PLUS))
     return out
 
 
@@ -75,6 +104,15 @@ class PartitionedTable:
     """Flat filter-row arrays with partition-chunked allocation.
 
     Chunk 0 is reserved empty (the padding target for per-topic chunk lists).
+
+    Small partitions PACK INTO SHARED CHUNKS: with depth-3 keys most
+    partitions hold a handful of rows, and giving each its own chunk
+    collapsed occupancy to ~2% at 1M filters (NOTES.md). A partition starts
+    inside shared chunks (foreign rows in a candidate chunk cost a little
+    compute — the match formula simply rejects them — not memory); once it
+    accumulates a full chunk's worth of rows it migrates to exclusive
+    chunks. Filter ids are therefore STABLE HANDLES decoupled from row
+    positions (`fid ↔ row` maps), so migration never breaks the router.
     """
 
     def __init__(self, max_levels: int = 8) -> None:
@@ -83,14 +121,26 @@ class PartitionedTable:
         self._cap_chunks = 64
         self._alloc(self._cap_chunks, max_levels)
         self.tokens = TokenDict()
-        # partition key → list of chunk ids owned
-        self._chunks_of: Dict[Tuple, List[int]] = {}
-        # partition key → free (unused) row slots in its chunks
-        self._free_of: Dict[Tuple, List[int]] = {}
+        # partition key → exclusive chunk ids / shared chunk ids it occupies
+        self._excl_chunks: Dict[Tuple, List[int]] = {}
+        self._shared_chunks_of: Dict[Tuple, Dict[int, int]] = {}  # cid → row count
+        # free row slots inside partition-exclusive chunks
+        self._excl_free: Dict[Tuple, List[int]] = {}
+        # shared-chunk pool: cid → free row slots; _open_shared lists chunk
+        # ids that still have free slots (O(1) allocation)
+        self._shared_free: Dict[int, List[int]] = {}
+        self._open_shared: List[int] = []
         self._key_of_fid: Dict[int, Tuple] = {}
+        # stable fid ↔ physical row
+        self._row_of_fid: Dict[int, int] = {}
+        self._fid_of_row: np.ndarray = np.full(self._cap_chunks * CHUNK, -1, dtype=np.int64)
+        self._next_fid = 0
+        # rows of a partition currently living in shared chunks
+        self._shared_rows_of: Dict[Tuple, List[int]] = {}
         self.size = 0
         self.version = 0
-        # per-(t0[,t1]) candidate-chunk-list caches, invalidated on mutation
+        self.dirty_ops = 0  # mutations since the last compact()
+        # per-(t0[,t1[,t2]]) candidate-chunk-list caches, invalidated on mutation
         self._cand_cache: Dict[Tuple, np.ndarray] = {}
         self._cand_version = -1
 
@@ -110,25 +160,102 @@ class PartitionedTable:
         new_lvl = max(need_levels, self.max_levels)
         if new_cap == self._cap_chunks and new_lvl == self.max_levels:
             return
-        old = (self.tok, self.flen, self.prefix_len, self.has_hash, self.first_wild)
+        old = (self.tok, self.flen, self.prefix_len, self.has_hash, self.first_wild,
+               self._fid_of_row)
         old_rows, old_lvl = self._cap_chunks * CHUNK, self.max_levels
         self._cap_chunks, self.max_levels = new_cap, new_lvl
         self._alloc(new_cap, new_lvl)
+        self._fid_of_row = np.full(new_cap * CHUNK, -1, dtype=np.int64)
         self.tok[:old_rows, :old_lvl] = old[0]
         self.flen[:old_rows] = old[1]
         self.prefix_len[:old_rows] = old[2]
         self.has_hash[:old_rows] = old[3]
         self.first_wild[:old_rows] = old[4]
+        self._fid_of_row[:old_rows] = old[5]
 
-    def _new_chunk(self, key: Tuple) -> int:
+    def _new_chunk(self) -> int:
         cid = self.nchunks
         self.nchunks += 1
         if self.nchunks > self._cap_chunks:
             self._grow(self.nchunks, self.max_levels)
-        self._chunks_of.setdefault(key, []).append(cid)
-        base = cid * CHUNK
-        self._free_of.setdefault(key, []).extend(range(base + CHUNK - 1, base - 1, -1))
         return cid
+
+    def _alloc_row(self, key: Tuple) -> int:
+        """Pick a physical row for a new filter of this partition."""
+        # 1) free slot in one of the partition's exclusive chunks
+        free = self._excl_free.get(key)
+        if free:
+            return free.pop()
+        shared_rows = self._shared_rows_of.setdefault(key, [])
+        excl = self._excl_chunks.get(key)
+        if excl or len(shared_rows) + 1 >= CHUNK:
+            # partition is (or becomes) big: use exclusive chunks; migrate
+            # any shared-resident rows into the new chunk first
+            cid = self._new_chunk()
+            base = cid * CHUNK
+            self._excl_chunks.setdefault(key, []).append(cid)
+            slots = list(range(base, base + CHUNK))
+            for src in shared_rows:
+                dst = slots.pop(0)
+                self._move_row(src, dst)
+            shared_rows.clear()
+            self._shared_chunks_of.pop(key, None)
+            self._excl_free[key] = slots[1:][::-1]
+            return slots[0]
+        # 2) small partition: take a slot in a shared chunk, preferring
+        # chunks this partition already occupies (keeps its candidate
+        # chunk-set small)
+        row = None
+        occ = self._shared_chunks_of.setdefault(key, {})
+        for cid in occ:
+            free_slots = self._shared_free.get(cid)
+            if free_slots:
+                row = free_slots.pop()
+                break
+        if row is None:
+            while self._open_shared:
+                cid = self._open_shared[-1]
+                free_slots = self._shared_free.get(cid)
+                if free_slots:
+                    row = free_slots.pop()
+                    break
+                self._open_shared.pop()  # exhausted chunk
+            else:
+                cid = self._new_chunk()
+                base = cid * CHUNK
+                self._shared_free[cid] = list(range(base + CHUNK - 1, base, -1))
+                self._open_shared.append(cid)
+                row = base
+        shared_rows.append(row)
+        occ[row // CHUNK] = occ.get(row // CHUNK, 0) + 1
+        return row
+
+    def _free_shared_slot(self, row: int) -> None:
+        cid = row // CHUNK
+        slots = self._shared_free.setdefault(cid, [])
+        if not slots:
+            self._open_shared.append(cid)
+        slots.append(row)
+
+    def _move_row(self, src: int, dst: int) -> None:
+        self.tok[dst] = self.tok[src]
+        self.flen[dst] = self.flen[src]
+        self.prefix_len[dst] = self.prefix_len[src]
+        self.has_hash[dst] = self.has_hash[src]
+        self.first_wild[dst] = self.first_wild[src]
+        fid = int(self._fid_of_row[src])
+        self._fid_of_row[dst] = fid
+        self._row_of_fid[fid] = dst
+        self._clear_row(src)
+        self._free_shared_slot(src)
+
+    def _clear_row(self, row: int) -> None:
+        self.tok[row, :] = PAD_TOK
+        self.flen[row] = -1
+        self.prefix_len[row] = 0
+        self.has_hash[row] = False
+        self.first_wild[row] = False
+        self._fid_of_row[row] = -1
 
     # ----------------------------------------------------------------- API
     def add(self, topic_filter: str | Sequence[str]) -> int:
@@ -137,41 +264,128 @@ class PartitionedTable:
         if nlev > self.max_levels:
             self._grow(self._cap_chunks, nlev)
         key = partition_key(levels)
-        free = self._free_of.get(key)
-        if not free:
-            self._new_chunk(key)
-            free = self._free_of[key]
-        fid = free.pop()
-        row = self.tok[fid]
-        row[:] = PAD_TOK
+        row = self._alloc_row(key)
+        tok_row = self.tok[row]
+        tok_row[:] = PAD_TOK
         for i, lev in enumerate(levels):
             if lev == PLUS:
-                row[i] = PLUS_TOK
+                tok_row[i] = PLUS_TOK
             elif lev == HASH:
-                row[i] = HASH_TOK
+                tok_row[i] = HASH_TOK
             else:
-                row[i] = self.tokens.intern(lev)
+                tok_row[i] = self.tokens.intern(lev)
         hh = levels[-1] == HASH
-        self.flen[fid] = nlev
-        self.prefix_len[fid] = nlev - 1 if hh else nlev
-        self.has_hash[fid] = hh
-        self.first_wild[fid] = levels[0] in (PLUS, HASH)
+        self.flen[row] = nlev
+        self.prefix_len[row] = nlev - 1 if hh else nlev
+        self.has_hash[row] = hh
+        self.first_wild[row] = levels[0] in (PLUS, HASH)
+        fid = self._next_fid
+        self._next_fid += 1
         self._key_of_fid[fid] = key
+        self._row_of_fid[fid] = row
+        self._fid_of_row[row] = fid
         self.size += 1
         self.version += 1
+        self.dirty_ops += 1
         return fid
 
     def remove(self, fid: int) -> None:
         key = self._key_of_fid.pop(fid, None)
         if key is None:
             raise KeyError(f"fid {fid} not active")
-        self.tok[fid, :] = PAD_TOK
-        self.flen[fid] = -1
-        self.prefix_len[fid] = 0
-        self.has_hash[fid] = False
-        self.first_wild[fid] = False
-        self._free_of[key].append(fid)
+        row = self._row_of_fid.pop(fid)
+        self._clear_row(row)
+        cid = row // CHUNK
+        occ = self._shared_chunks_of.get(key)
+        if occ is not None and cid in occ:
+            # row lived in a shared chunk
+            occ[cid] -= 1
+            if occ[cid] == 0:
+                del occ[cid]
+            self._shared_rows_of[key].remove(row)
+            self._free_shared_slot(row)
+        else:
+            self._excl_free.setdefault(key, []).append(row)
         self.size -= 1
+        self.version += 1
+        self.dirty_ops += 1
+
+    def compact(self) -> None:
+        """Rebuild the physical layout: each partition's rows contiguous,
+        partitions packed back-to-back (boundary chunks shared between
+        neighbors). Restores ~100% occupancy and minimal candidate chunk
+        sets after bulk loads/churn; filter ids are stable across the move.
+        """
+        by_key: Dict[Tuple, List[int]] = {}
+        for fid, key in self._key_of_fid.items():
+            by_key.setdefault(key, []).append(fid)
+        src_rows = []
+        fids_ordered = []
+        for key in sorted(by_key, key=repr):
+            for fid in by_key[key]:
+                fids_ordered.append(fid)
+                src_rows.append(self._row_of_fid[fid])
+        src = np.asarray(src_rows, dtype=np.int64)
+        n = len(src)
+        need_chunks = 1 + (n + CHUNK - 1) // CHUNK + 1
+        # snapshot source data (may alias destination rows)
+        tok = self.tok[src].copy()
+        flen = self.flen[src].copy()
+        pl = self.prefix_len[src].copy()
+        hh = self.has_hash[src].copy()
+        fw = self.first_wild[src].copy()
+        if need_chunks > self._cap_chunks:
+            self._grow(need_chunks, self.max_levels)
+        # reset physical state
+        self.tok[:, :] = PAD_TOK
+        self.flen[:] = -1
+        self.prefix_len[:] = 0
+        self.has_hash[:] = False
+        self.first_wild[:] = False
+        self._fid_of_row[:] = -1
+        dst = np.arange(CHUNK, CHUNK + n, dtype=np.int64)  # chunk 0 stays empty
+        self.tok[dst] = tok
+        self.flen[dst] = flen
+        self.prefix_len[dst] = pl
+        self.has_hash[dst] = hh
+        self.first_wild[dst] = fw
+        fid_arr = np.asarray(fids_ordered, dtype=np.int64)
+        self._fid_of_row[dst] = fid_arr
+        self._row_of_fid = {int(f): int(r) for f, r in zip(fid_arr, dst)}
+        # rebuild partition structures: spanned chunks per key. Partitions
+        # below one chunk stay classified as SHARED-resident so later adds
+        # keep packing instead of each claiming a fresh exclusive chunk
+        # (which would re-create the sparse layout compact() just removed).
+        self._excl_chunks = {}
+        self._excl_free = {}
+        self._shared_chunks_of = {}
+        self._shared_rows_of = {}
+        self._shared_free = {}
+        self._open_shared = []
+        pos = CHUNK
+        for key in sorted(by_key, key=repr):
+            k = len(by_key[key])
+            first_chunk = pos // CHUNK
+            last_chunk = (pos + k - 1) // CHUNK
+            if k < CHUNK:
+                rows = list(range(pos, pos + k))
+                self._shared_rows_of[key] = rows
+                occ: Dict[int, int] = {}
+                for r in rows:
+                    occ[r // CHUNK] = occ.get(r // CHUNK, 0) + 1
+                self._shared_chunks_of[key] = occ
+            else:
+                self._excl_chunks[key] = list(range(first_chunk, last_chunk + 1))
+            pos += k
+        self.nchunks = (pos + CHUNK - 1) // CHUNK
+        # the tail of the last chunk is unowned free space: future adds for
+        # any key fall through _alloc_row's shared path
+        tail_start = pos
+        tail_end = self.nchunks * CHUNK
+        if tail_end > tail_start:
+            self._shared_free[self.nchunks - 1] = list(range(tail_end - 1, tail_start - 1, -1))
+            self._open_shared.append(self.nchunks - 1)
+        self.dirty_ops = 0
         self.version += 1
 
     # -------------------------------------------------------- topic encode
@@ -184,6 +398,10 @@ class PartitionedTable:
         reserved empty chunk 0; NC is the batch max (padded to a power of
         two to bound recompiles).
         """
+        if self.dirty_ops > max(1024, self.size // 5):
+            # heavy churn fragments the layout; rebuild before encoding so
+            # chunk ids reflect the fresh layout
+            self.compact()
         batch = len(topics)
         b = pad_batch_to or batch
         lvl = self.max_levels
@@ -203,14 +421,27 @@ class PartitionedTable:
             row = [lookup(lev) for lev in levels[:lvl]]
             row += [PAD_TOK] * (lvl - len(row))
             tok_rows.append(row)
-            # candidate chunks: cached per (t0,) / (t0, t1) — topics share
-            # these heavily (the wildcard partitions are common to all)
-            ckey = (levels[0],) if len(levels) == 1 else (levels[0], levels[1])
+            # candidate chunks: cached per effective prefix — topics share
+            # these heavily (the wildcard partitions are common to all).
+            # The key must cover every level the partition scheme inspects
+            # (1, 2 or 3 depending on topic depth).
+            ckey = tuple(levels[:3]) if len(levels) >= 3 else tuple(levels)
+            ckey = (len(ckey),) + ckey
             cand = cache.get(ckey)
             if cand is None:
                 chunks: List[int] = []
+                seen: set = set()  # partitions share boundary/shared chunks
                 for key in topic_partitions(levels):
-                    chunks.extend(self._chunks_of.get(key, ()))
+                    for cid in self._excl_chunks.get(key, ()):
+                        if cid not in seen:
+                            seen.add(cid)
+                            chunks.append(cid)
+                    occ = self._shared_chunks_of.get(key)
+                    if occ:
+                        for cid in occ:
+                            if cid not in seen:
+                                seen.add(cid)
+                                chunks.append(cid)
                 cand = np.asarray(chunks, dtype=np.int32)
                 cache[ckey] = cand
             per_topic_chunks.append(cand)
@@ -326,11 +557,14 @@ class PartitionedMatcher:
             if int(cn[:b].max(initial=0)) <= max_words:
                 break
             max_words = 1 << (int(cn[:b].max()) - 1).bit_length()  # rare: re-run wider
-        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b)
+        rows = _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b)
+        # physical rows → stable filter ids (rows migrate between chunks)
+        fid_map = self.table._fid_of_row
+        return [np.sort(fid_map[r]) for r in rows]
 
 
 def _decode_batch(wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int) -> List[np.ndarray]:
-    """Vectorized (word_idx, word_bits) → per-topic fid arrays."""
+    """Vectorized (word_idx, word_bits) → per-topic matched ROW arrays."""
     wpc = WORDS_PER_CHUNK
     k = wi.shape[1]
     bitpos = np.unpackbits(
@@ -338,12 +572,12 @@ def _decode_batch(wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int)
     ).reshape(b, k, 32)
     tj, kj, cols = np.nonzero(bitpos)
     widx = wi[tj, kj]
-    fids = (
+    rows = (
         chunk_ids[tj, widx // wpc].astype(np.int64) * CHUNK
         + (widx % wpc).astype(np.int64) * 32
         + cols
     )
-    order = np.lexsort((fids, tj))
-    tj, fids = tj[order], fids[order]
+    order = np.lexsort((rows, tj))
+    tj, rows = tj[order], rows[order]
     bounds = np.searchsorted(tj, np.arange(1, b))
-    return np.split(fids, bounds)
+    return np.split(rows, bounds)

@@ -21,9 +21,12 @@ def test_partition_key_shapes():
     assert partition_key(["+"]) == ("1", "+")
     assert partition_key(["a", "#"]) == ("2", "a")
     assert partition_key(["+", "#"]) == ("2", "+")
-    assert partition_key(["a", "b"]) == ("3", "a", "b")
-    assert partition_key(["a", "+", "#"]) == ("3", "a", "+")
-    assert partition_key(["", "+"]) == ("3", "", "+")
+    assert partition_key(["a", "b"]) == ("2E", "a", "b")
+    assert partition_key(["", "+"]) == ("2E", "", "+")
+    assert partition_key(["a", "+", "#"]) == ("H3", "a", "+")
+    assert partition_key(["a", "b", "c"]) == ("4", "a", "b", "c")
+    assert partition_key(["a", "+", "c", "d", "#"]) == ("4", "a", "+", "c")
+    assert partition_key(["a", "b", "+"]) == ("4", "a", "b", "+")
 
 
 def test_topic_partition_coverage_brute_force():
