@@ -315,11 +315,16 @@ async def _amain(args) -> None:
     cluster = None
     if settings.cluster_listen:
         if settings.broker.cluster_mode == "raft":
-            from rmqtt_tpu.cluster.raft_mode import RaftCluster as ClusterImpl
-        else:
-            from rmqtt_tpu.cluster.broadcast import BroadcastCluster as ClusterImpl
+            from rmqtt_tpu.cluster.raft_mode import RaftCluster
 
-        cluster = ClusterImpl(broker.ctx, settings.cluster_listen, settings.peers)
+            cluster = RaftCluster(
+                broker.ctx, settings.cluster_listen, settings.peers,
+                raft_db=settings.raft_db,
+            )
+        else:
+            from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+
+            cluster = BroadcastCluster(broker.ctx, settings.cluster_listen, settings.peers)
         await cluster.start()
     api = None
     if settings.http_api:

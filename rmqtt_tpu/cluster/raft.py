@@ -4,9 +4,10 @@ The reference's raft mode uses the external `rmqtt-raft` crate (SURVEY.md
 §2.3); there is no Python/C++ drop-in in this image, so this is an
 independent compact Raft: leader election with randomized timeouts,
 AppendEntries log replication with commit on majority, leader forwarding for
-proposals, and full-log catch-up for (re)joining nodes. State is in-memory —
-a restarted node rejoins empty and catches up from the leader's log (the
-reference additionally snapshots+compacts; noted as a production gap).
+proposals, and full-log catch-up for (re)joining nodes. Term/vote and the
+log persist to SQLite when a storage is attached (cluster.raft_db), so a
+restarted node reloads and re-applies its own log instead of refetching it;
+log compaction/snapshotting remains a gap (PLAN.md).
 
 RPCs ride the cluster transport (`cluster/transport.py`) with message types
 ``raft_vote`` / ``raft_append`` / ``raft_propose``.
@@ -38,16 +39,30 @@ class RaftNode:
         apply_cb: Callable[[Any], Awaitable[None]],
         election_timeout: Tuple[float, float] = (0.3, 0.6),
         heartbeat: float = 0.1,
+        storage=None,
     ) -> None:
         self.node_id = node_id
         self.peers = peers
         self.apply_cb = apply_cb
         self.election_timeout = election_timeout
         self.heartbeat = heartbeat
+        # optional durable state (SqliteStore): term/vote + the log survive
+        # restarts, so a rejoining node re-applies its own log instead of
+        # refetching everything (reference persists via raft snapshots)
+        self.storage = storage
 
         self.term = 0
         self.voted_for: Optional[int] = None
         self.log: List[Tuple[int, Any]] = []  # (term, entry)
+        if storage is not None:
+            meta = storage.get("raft", "meta")
+            if meta:
+                self.term = int(meta["term"])
+                self.voted_for = meta["voted_for"]
+            rows = sorted(
+                ((int(k), v) for k, v in storage.scan("raft_log")), key=lambda kv: kv[0]
+            )
+            self.log = [(int(t), e) for _idx, (t, e) in rows]
         self.commit_index = 0  # 1-based count of committed entries
         self.last_applied = 0
         self.state = FOLLOWER
@@ -82,6 +97,27 @@ class RaftNode:
                 pass
         self._tasks = []
 
+    def _save_meta(self) -> None:
+        if self.storage is not None:
+            self.storage.put("raft", "meta", {"term": self.term, "voted_for": self.voted_for})
+
+    def _persist_append(self, start_idx: int) -> None:
+        """Persist log entries from 1-based ``start_idx`` to the end — one
+        transaction regardless of batch size (a far-behind follower receives
+        its whole backlog in one AppendEntries)."""
+        if self.storage is not None:
+            self.storage.put_many(
+                "raft_log",
+                [(str(idx), list(self.log[idx - 1]))
+                 for idx in range(start_idx, len(self.log) + 1)],
+            )
+
+    def _persist_truncate(self, new_len: int) -> None:
+        if self.storage is not None:
+            idx = new_len + 1
+            while self.storage.delete("raft_log", str(idx)):
+                idx += 1
+
     @property
     def is_leader(self) -> bool:
         return self.state == LEADER
@@ -104,6 +140,7 @@ class RaftNode:
         self.term += 1
         self.state = CANDIDATE
         self.voted_for = self.node_id
+        self._save_meta()
         self.leader_id = None
         term = self.term
         last_idx = len(self.log)
@@ -136,6 +173,12 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.state = LEADER
         self.leader_id = self.node_id
+        # a fresh leader cannot commit prior-term entries by counting
+        # replicas (Raft §5.4.2) — append a current-term no-op (entry=None,
+        # outside the application payload space) so the whole log prefix
+        # commits through it
+        self.log.append((self.term, None))
+        self._persist_append(len(self.log))
         nxt = len(self.log) + 1
         self._next_index = {nid: nxt for nid in self.peers}
         self._match_index = {nid: 0 for nid in self.peers}
@@ -148,6 +191,7 @@ class RaftNode:
         if term > self.term:
             self.term = term
             self.voted_for = None
+            self._save_meta()
         if self.state != FOLLOWER:
             log.info("raft node %s steps down (term %s)", self.node_id, self.term)
         self.state = FOLLOWER
@@ -215,10 +259,13 @@ class RaftNode:
             while self.last_applied < self.commit_index:
                 self.last_applied += 1
                 _term, entry = self.log[self.last_applied - 1]
-                try:
-                    await self.apply_cb(entry)
-                except Exception:
-                    log.exception("raft apply failed at %s", self.last_applied)
+                if entry is None:
+                    pass  # leader-election no-op, not application state
+                else:
+                    try:
+                        await self.apply_cb(entry)
+                    except Exception:
+                        log.exception("raft apply failed at %s", self.last_applied)
                 fut = self._commit_waiters.pop(self.last_applied, None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
@@ -234,6 +281,7 @@ class RaftNode:
             if self.state == LEADER:
                 self.log.append((self.term, entry))
                 idx = len(self.log)
+                self._persist_append(idx)
                 fut = asyncio.get_running_loop().create_future()
                 self._commit_waiters[idx] = fut
                 await self._replicate_all()
@@ -282,6 +330,7 @@ class RaftNode:
                 raise ClusterReplyError("not leader")
             self.log.append((self.term, body["entry"]))
             idx = len(self.log)
+            self._persist_append(idx)
             fut = asyncio.get_running_loop().create_future()
             self._commit_waiters[idx] = fut
             await self._replicate_all()
@@ -305,6 +354,7 @@ class RaftNode:
             if up_to_date:
                 granted = True
                 self.voted_for = body["candidate"]
+                self._save_meta()
                 self._last_heartbeat = asyncio.get_running_loop().time()
         return {"term": self.term, "granted": granted}
 
@@ -326,14 +376,22 @@ class RaftNode:
             return {"term": self.term, "success": False, "match": self.commit_index}
         # append, truncating only on an actual conflict (Raft §5.3 — a
         # reordered stale AppendEntries must not clobber newer entries)
-        for i, (t, e) in enumerate([(t, e) for t, e in body["entries"]]):
+        appended_from = None
+        for i, (t, e) in enumerate(body["entries"]):
             pos = prev_index + i
             if pos < len(self.log):
                 if self.log[pos][0] != t:
                     self.log = self.log[:pos]
+                    self._persist_truncate(pos)
                     self.log.append((t, e))
+                    if appended_from is None:
+                        appended_from = pos + 1
             else:
                 self.log.append((t, e))
+                if appended_from is None:
+                    appended_from = pos + 1
+        if appended_from is not None:
+            self._persist_append(appended_from)
         if body["leader_commit"] > self.commit_index:
             self.commit_index = min(body["leader_commit"], len(self.log))
             await self._apply_committed()

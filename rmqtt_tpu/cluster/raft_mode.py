@@ -161,6 +161,7 @@ class RaftCluster:
         listen: Tuple[str, int],
         peers: List[Tuple[int, str, int]],
         sync_retains: bool = True,
+        raft_db: Optional[str] = None,
     ) -> None:
         self.ctx = ctx
         self.server = ClusterServer(listen[0], listen[1], self._on_message)
@@ -169,7 +170,12 @@ class RaftCluster:
         }
         self.bcast = Broadcaster(list(self.peers.values()))
         self.sync_retains = sync_retains
-        self.raft = RaftNode(ctx.node_id, self.peers, self._apply)
+        storage = None
+        if raft_db:
+            from rmqtt_tpu.storage.sqlite import SqliteStore
+
+            storage = SqliteStore(raft_db)
+        self.raft = RaftNode(ctx.node_id, self.peers, self._apply, storage=storage)
         assert isinstance(ctx.registry, RaftSessionRegistry), (
             "raft mode needs ServerContext with registry='raft'"
         )
@@ -199,6 +205,8 @@ class RaftCluster:
         await self.server.stop()
         for p in self.peers.values():
             await p.close()
+        if self.raft.storage is not None:
+            self.raft.storage.close()
 
     # ------------------------------------------------------- replicated ops
     async def _apply(self, entry: Any) -> None:

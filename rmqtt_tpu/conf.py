@@ -75,6 +75,7 @@ class Settings:
     broker: BrokerConfig
     http_api: Optional[Dict[str, Any]]  # {"host":..., "port":...} or None
     cluster_listen: Optional[Tuple[str, int]]
+    raft_db: Optional[str]
     peers: List[Tuple[int, str, int]]
     plugins: Dict[str, Dict[str, Any]]  # name → config
     default_startups: List[str]
@@ -123,12 +124,14 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
             broker_kwargs["retain_max"] = int(retain["max_retained"])
 
     cluster_listen = None
+    raft_db = None
     peers: List[Tuple[int, str, int]] = []
     if cluster.get("listen"):
         host, _, port = str(cluster["listen"]).rpartition(":")
         cluster_listen = (host or "0.0.0.0", int(port))
         broker_kwargs["cluster"] = True
         broker_kwargs["cluster_mode"] = cluster.get("mode", "broadcast")
+        raft_db = cluster.get("raft_db")
         for spec in cluster.get("peers", []):
             nid, _, addr = str(spec).partition("@")
             phost, _, pport = addr.rpartition(":")
@@ -148,6 +151,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         broker=BrokerConfig(**broker_kwargs),
         http_api=http_api,
         cluster_listen=cluster_listen,
+        raft_db=raft_db,
         peers=peers,
         plugins=plugin_cfgs,
         default_startups=default_startups,

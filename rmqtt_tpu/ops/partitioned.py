@@ -400,7 +400,9 @@ class PartitionedTable:
         """
         if self.dirty_ops > max(1024, self.size // 5):
             # heavy churn fragments the layout; rebuild before encoding so
-            # chunk ids reflect the fresh layout
+            # chunk ids reflect the fresh layout. In the broker this runs on
+            # the RoutingService's executor thread (routing.py dispatches
+            # matches_batch_raw via run_in_executor), not the event loop.
             self.compact()
         batch = len(topics)
         b = pad_batch_to or batch

@@ -48,6 +48,15 @@ class SqliteStore:
         )
         self._db.commit()
 
+    def put_many(self, ns: str, items) -> None:
+        """Bulk upsert in ONE transaction (large raft appends must not pay a
+        commit per row)."""
+        self._db.executemany(
+            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,NULL)",
+            [(ns, k, wire.dumps(v)) for k, v in items],
+        )
+        self._db.commit()
+
     def get(self, ns: str, key: str) -> Optional[Any]:
         row = self._db.execute(
             "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
