@@ -273,6 +273,9 @@ class SessionState:
         self.codec = codec
         self._wlock = asyncio.Lock()
         self._alias_in: Dict[int, str] = {}
+        # outbound aliasing (v5): topic → alias, bounded by the client's
+        # advertised Topic Alias Maximum (session.rs topic-alias tables)
+        self._alias_out: Dict[str, int] = {}
         self._last_packet = time.monotonic()
         self._clean_disconnect = False
         self._kicked = False
@@ -402,8 +405,21 @@ class SessionState:
             s.out_inflight.push(
                 OutEntry(packet_id, msg, item.qos, subscription_ids=item.sub_ids)
             )
+        # outbound topic alias AFTER the drop checks: an alias must never be
+        # registered for a publish that does not reach the wire (the client
+        # would see later empty-topic reuses as 0x94 protocol errors)
+        topic_out = msg.topic
+        if self.codec.version == pk.V5 and s.limits.max_topic_aliases_out > 0:
+            alias = self._alias_out.get(msg.topic)
+            if alias is not None:
+                props[P.TOPIC_ALIAS] = alias
+                topic_out = ""  # established alias: omit the topic bytes
+            elif len(self._alias_out) < s.limits.max_topic_aliases_out:
+                alias = len(self._alias_out) + 1
+                self._alias_out[msg.topic] = alias
+                props[P.TOPIC_ALIAS] = alias  # first use carries both
         pub = pk.Publish(
-            topic=msg.topic,
+            topic=topic_out,
             payload=msg.payload,
             qos=item.qos,
             retain=item.retain,

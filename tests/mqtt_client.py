@@ -36,6 +36,7 @@ class TestClient:
         self._task: Optional[asyncio.Task] = None
         self.auto_ack = True
         self.closed = asyncio.Event()
+        self._alias_map = {}
 
     # ------------------------------------------------------------- connect
     @classmethod
@@ -109,6 +110,17 @@ class TestClient:
         if isinstance(p, pk.Connack):
             self._resolve(("connack",), p)
         elif isinstance(p, pk.Publish):
+            from rmqtt_tpu.broker.codec import props as _props
+
+            alias = p.properties.get(_props.TOPIC_ALIAS)
+            p.wire_topic_empty = not p.topic
+            if alias is not None:
+                if p.topic:
+                    self._alias_map[alias] = p.topic
+                else:
+                    if alias not in self._alias_map:
+                        raise AssertionError(f"unknown topic alias {alias} from broker")
+                    p.topic = self._alias_map[alias]
             if self.auto_ack:
                 if p.qos == 1:
                     await self._send(pk.Puback(p.packet_id))

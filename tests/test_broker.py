@@ -361,3 +361,27 @@ async def test_stats_and_metrics(broker):
     assert stats.sessions == 1
     assert stats.topics == 1
     assert broker.ctx.metrics.get("connections.established") >= 1
+
+
+@broker_test
+async def test_outbound_topic_alias_v5(broker):
+    from rmqtt_tpu.broker.codec import props as P
+
+    sub = await connect(broker, "alias-sub", version=pk.V5,
+                        properties={P.TOPIC_ALIAS_MAXIMUM: 4})
+    sub.auto_ack = True
+    await sub.subscribe("al/#", qos=0)
+    pub = await connect(broker, "alias-pub")
+    raw = []
+    for i in range(3):
+        await pub.publish("al/same/topic", str(i).encode())
+        p = await sub.recv()
+        raw.append(p)
+        assert p.topic == "al/same/topic"  # client resolves via alias map
+    # second+ deliveries used the alias with empty topic bytes on the wire
+    assert P.TOPIC_ALIAS in raw[1].properties
+    assert not raw[0].wire_topic_empty and raw[1].wire_topic_empty and raw[2].wire_topic_empty
+    # a different topic gets its own alias
+    await pub.publish("al/other", b"x")
+    p = await sub.recv()
+    assert p.topic == "al/other"
