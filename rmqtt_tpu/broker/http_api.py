@@ -27,6 +27,28 @@ log = logging.getLogger("rmqtt_tpu.http")
 _STARTED_AT = time.time()
 
 
+def sysinfo() -> dict:
+    """Host load/memory figures (node.rs sysinfo surface)."""
+    import os
+
+    out: dict = {}
+    try:
+        l1, l5, l15 = os.getloadavg()
+        out["load1"], out["load5"], out["load15"] = round(l1, 2), round(l5, 2), round(l15, 2)
+    except (OSError, AttributeError):  # AttributeError: not on Windows
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["memory_rss_kb"] = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    out["cpus"] = os.cpu_count()
+    return out
+
+
 def client_info(s) -> dict:
     """Serialized client/session row (api.rs clients payload shape)."""
     return {
@@ -264,6 +286,7 @@ class HttpApi:
             "retaineds": stats.retaineds,
             "version": __version__,
             "uptime": round(time.time() - _STARTED_AT, 1),
+            **sysinfo(),
         }
 
     def _prometheus(self) -> str:

@@ -136,27 +136,52 @@ class RaftNode:
             if loop.time() - self._last_heartbeat >= timeout:
                 await self._campaign()
 
+    async def _request_votes(self, term: int, prevote: bool):
+        last_idx = len(self.log)
+        last_term = self.log[-1][0] if self.log else 0
+
+        async def ask(peer: PeerClient):
+            try:
+                body = {
+                    "term": term, "candidate": self.node_id,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }
+                if prevote:
+                    body["prevote"] = True
+                return await peer.call(RAFT_VOTE, body, timeout=self.election_timeout[0])
+            except (PeerUnavailable, ClusterReplyError):
+                return None
+
+        return await asyncio.gather(*(ask(p) for p in self.peers.values()))
+
+    def _heard_from_leader_recently(self) -> bool:
+        return (
+            asyncio.get_running_loop().time() - self._last_heartbeat
+            < self.election_timeout[0]
+        )
+
     async def _campaign(self) -> None:
+        # PRE-VOTE (Raft §9.6): ask peers whether they WOULD vote for us at
+        # term+1 without disturbing anyone's persistent term. Prevents the
+        # election storms a partitioned/restarting node causes by endlessly
+        # inflating terms it can never win with.
+        if self.peers:
+            replies = await self._request_votes(self.term + 1, prevote=True)
+            votes = 1 + sum(1 for r in replies if r is not None and r.get("granted"))
+            if votes < self._quorum():
+                return
+            # the pre-vote round took time: if a live leader (or newer term)
+            # showed up meanwhile, stand down instead of disrupting it
+            if self._heard_from_leader_recently() or self.state == LEADER:
+                return
         self.term += 1
         self.state = CANDIDATE
         self.voted_for = self.node_id
         self._save_meta()
         self.leader_id = None
         term = self.term
-        last_idx = len(self.log)
-        last_term = self.log[-1][0] if self.log else 0
         votes = 1
-
-        async def ask(peer: PeerClient):
-            try:
-                return await peer.call(RAFT_VOTE, {
-                    "term": term, "candidate": self.node_id,
-                    "last_log_index": last_idx, "last_log_term": last_term,
-                }, timeout=self.election_timeout[0])
-            except (PeerUnavailable, ClusterReplyError):
-                return None
-
-        replies = await asyncio.gather(*(ask(p) for p in self.peers.values()))
+        replies = await self._request_votes(term, prevote=False)
         if self.term != term or self.state != CANDIDATE:
             return  # a newer term interrupted the campaign
         for reply in replies:
@@ -343,14 +368,21 @@ class RaftNode:
 
     def _on_vote(self, body: dict) -> dict:
         term = body["term"]
+        my_last_term = self.log[-1][0] if self.log else 0
+        up_to_date = (body["last_log_term"], body["last_log_index"]) >= (
+            my_last_term, len(self.log)
+        )
+        if body.get("prevote"):
+            # pre-vote: no state changes; grant iff we'd grant a real vote
+            # at that term AND no leader looks alive — ourselves included
+            # (a leader's own _last_heartbeat is not refreshed while leading)
+            leader_alive = self.state == LEADER or self._heard_from_leader_recently()
+            granted = term >= self.term and up_to_date and not leader_alive
+            return {"term": self.term, "granted": granted}
         if term > self.term:
             self._step_down(term)
         granted = False
         if term >= self.term and self.voted_for in (None, body["candidate"]):
-            my_last_term = self.log[-1][0] if self.log else 0
-            up_to_date = (body["last_log_term"], body["last_log_index"]) >= (
-                my_last_term, len(self.log)
-            )
             if up_to_date:
                 granted = True
                 self.voted_for = body["candidate"]
