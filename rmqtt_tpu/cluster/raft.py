@@ -167,6 +167,13 @@ class RaftNode:
         # inflating terms it can never win with.
         if self.peers:
             replies = await self._request_votes(self.term + 1, prevote=True)
+            # term catch-up: a denial can carry a newer term (e.g. a peer
+            # restarted with an inflated persisted term) — adopt it or this
+            # node's pre-votes stay permanently too stale to ever pass
+            for r in replies:
+                if r is not None and r.get("term", 0) > self.term:
+                    self._step_down(r["term"])
+                    return  # retry next election tick at the caught-up term
             votes = 1 + sum(1 for r in replies if r is not None and r.get("granted"))
             if votes < self._quorum():
                 return

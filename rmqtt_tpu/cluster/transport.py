@@ -57,7 +57,7 @@ class CircuitBreaker:
     """Open after ``threshold`` consecutive failures; half-open probe after
     ``cooldown`` seconds (reference CircuitBreakerConfig, context.rs:585-677)."""
 
-    def __init__(self, threshold: int = 5, cooldown: float = 10.0) -> None:
+    def __init__(self, threshold: int = 5, cooldown: float = 3.0) -> None:
         self.threshold = threshold
         self.cooldown = cooldown
         self.failures = 0
@@ -76,8 +76,16 @@ class CircuitBreaker:
 
     def fail(self) -> None:
         self.failures += 1
-        if self.failures >= self.threshold:
-            self.opened_at = time.monotonic()
+        now = time.monotonic()
+        if self.opened_at is None:
+            if self.failures >= self.threshold:
+                self.opened_at = now
+        elif now - self.opened_at >= self.cooldown:
+            # a half-open PROBE failed: re-arm the cooldown window.
+            # Rejected-while-open attempts must NOT re-arm it — that would
+            # keep the breaker open forever under a fast retry loop (the
+            # raft heartbeat), blocking peer recovery permanently.
+            self.opened_at = now
 
 
 class PeerClient:
