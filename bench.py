@@ -319,26 +319,11 @@ def run_retained(sub_table, retained_topics, publish_topics):
 
 
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
-    """Probe the TPU in a subprocess: the axon grant can be wedged by a
-    previously-killed client, in which case jax.devices() blocks forever
-    in-process (NOTES.md). A subprocess probe can be timed out safely."""
-    import subprocess
+    """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
+    grant can be wedged, making in-process jax.devices() block forever)."""
+    from rmqtt_tpu.utils.tpuprobe import tpu_available as _probe
 
-    for attempt in range(retries):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout,
-                capture_output=True,
-            )
-            if r.returncode == 0:
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt + 1 < retries:
-            log(f"tpu probe attempt {attempt + 1}/{retries} failed; retrying")
-            time.sleep(15)
-    return False
+    return _probe(timeout=probe_timeout, retries=retries)
 
 
 def main():

@@ -180,14 +180,24 @@ class MqttClient:
         if not self.connected.is_set():
             return False
         pid = next(self._pid) if qos else None
-        await self._send(
-            pk.Publish(topic=topic, payload=payload, qos=qos, retain=retain, packet_id=pid)
-        )
+        # install the ack future BEFORE the send: drain() can suspend under
+        # write backpressure, letting the read loop process the PUBACK first
+        fut = None
         if qos and wait_ack:
             fut = asyncio.get_running_loop().create_future()
             self._acks[pid] = fut
+        try:
+            await self._send(
+                pk.Publish(topic=topic, payload=payload, qos=qos, retain=retain, packet_id=pid)
+            )
+        except (ConnectionError, OSError):
+            if fut is not None:
+                self._acks.pop(pid, None)
+            return False
+        if fut is not None:
             try:
                 await asyncio.wait_for(fut, timeout)
             except (asyncio.TimeoutError, ConnectionError):
+                self._acks.pop(pid, None)
                 return False
         return True

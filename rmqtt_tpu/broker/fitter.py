@@ -32,7 +32,7 @@ class Limits:
 class FitterConfig:
     max_keepalive: int = 0  # 0 = no clamp
     min_keepalive: int = 0
-    keepalive_backoff: float = 0.75  # timeout factor: keepalive / backoff / 2
+    keepalive_backoff: float = 0.75  # timeout factor: keepalive * backoff * 2
     max_inflight: int = 16
     max_mqueue: int = 1000
     max_session_expiry: float = 2 * 3600.0
@@ -82,7 +82,11 @@ class Fitter:
         )
 
     def keepalive_timeout(self, keepalive: int) -> float:
-        """Socket-idle deadline (fitter.rs backoff: keepalive * 1.5 default)."""
+        """Socket-idle deadline, always > keepalive (fitter.rs:158-163:
+        small keepalives get +3s slack, otherwise keepalive * backoff * 2 —
+        1.5x with the default backoff of 0.75)."""
         if keepalive == 0:
             return 0.0
-        return keepalive / self.cfg.keepalive_backoff / 2
+        if keepalive < 6:
+            return float(keepalive + 3)
+        return keepalive * self.cfg.keepalive_backoff * 2

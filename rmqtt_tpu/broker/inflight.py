@@ -34,6 +34,10 @@ class OutEntry:
     sent_at: float = field(default_factory=time.monotonic)
     retries: int = 0
     subscription_ids: tuple = ()
+    # wire fields of the original delivery, so a DUP retransmission matches
+    # it (retain-as-published flag, v5 content/correlation/sub-id props)
+    retain: bool = False
+    wire_props: dict = field(default_factory=dict)
 
 
 class OutInflight:

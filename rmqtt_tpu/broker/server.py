@@ -155,26 +155,32 @@ class MqttBroker:
         ctx.handshake_rate.inc()
         try:
             try:
-                connect = await asyncio.wait_for(
+                got = await asyncio.wait_for(
                     self._read_connect(reader, codec), timeout=ctx.cfg.max_handshake_delay
                 )
             except (asyncio.TimeoutError, ProtocolViolation, ConnectionError):
                 ctx.metrics.inc("handshake.failures")
                 writer.close()
                 return
-            if connect is None:
+            if got is None:
                 writer.close()
                 return
+            connect, early = got
             state = await self._handshake(connect, reader, writer, codec, peer)
         finally:
             ctx.handshaking -= 1
         if state is not None:
+            state.early_packets = early
             try:
                 await state.run()
             finally:
                 ctx.metrics.inc("connections.closed")
 
-    async def _read_connect(self, reader, codec) -> Optional[pk.Connect]:
+    async def _read_connect(self, reader, codec):
+        """Returns (Connect, trailing packets) or None. Clients may legally
+        pipeline SUBSCRIBE/PUBLISH behind CONNECT in one TCP segment without
+        waiting for CONNACK; trailing packets decoded from the same feed are
+        replayed into the session read loop after the handshake."""
         while True:
             data = await reader.read(65536)
             if not data:
@@ -184,7 +190,7 @@ class MqttBroker:
                 p = packets[0]
                 if not isinstance(p, pk.Connect):
                     return None
-                return p
+                return p, packets[1:]
 
     async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer):
         """v5.rs `_handshake` :191-410 (v3 mirror). Returns the ready

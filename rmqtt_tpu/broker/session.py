@@ -168,7 +168,8 @@ class Session:
                 continue
             items.append(
                 DeliverItem(
-                    msg=e.msg, qos=e.qos, retain=False, topic_filter="", sub_ids=e.subscription_ids, dup=True
+                    msg=e.msg, qos=e.qos, retain=e.retain, topic_filter="",
+                    sub_ids=e.subscription_ids, dup=True,
                 )
             )
         q = self.deliver_queue.drain()
@@ -196,7 +197,7 @@ def session_snapshot(s: Session, max_queue_items: Optional[int] = None) -> dict:
     # new connection cannot resume the old packet-id handshake
     for e in s.out_inflight.drain():
         if e.status is not MomentStatus.UNCOMPLETE:
-            items.append([e.qos, False, "", list(e.subscription_ids), msg_to_wire(e.msg), True])
+            items.append([e.qos, e.retain, "", list(e.subscription_ids), msg_to_wire(e.msg), True])
     for it in s.deliver_queue._q:
         items.append([it.qos, it.retain, it.topic_filter, list(it.sub_ids), msg_to_wire(it.msg), it.dup])
     if max_queue_items is not None:
@@ -281,6 +282,9 @@ class SessionState:
         self._kicked = False
         self._closing = asyncio.Event()
         self._disconnect_reason: Optional[int] = None
+        # packets a client pipelined behind CONNECT in the same TCP segment
+        # (legal without waiting for CONNACK); replayed by _read_loop
+        self.early_packets: list = []
 
     # ------------------------------------------------------------------ io
     async def send(self, packet) -> None:
@@ -343,6 +347,9 @@ class SessionState:
         return "socket-closed"
 
     async def _read_loop(self) -> None:
+        early, self.early_packets = self.early_packets, []
+        for p in early:
+            await self._handle(p)
         while True:
             data = await self.reader.read(65536)
             if not data:
@@ -403,7 +410,10 @@ class SessionState:
                 await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "no-packet-id")
                 return
             s.out_inflight.push(
-                OutEntry(packet_id, msg, item.qos, subscription_ids=item.sub_ids)
+                OutEntry(
+                    packet_id, msg, item.qos, subscription_ids=item.sub_ids,
+                    retain=item.retain, wire_props=dict(props),
+                )
             )
         # outbound topic alias AFTER the drop checks: an alias must never be
         # registered for a publish that does not reach the wire (the client
@@ -445,14 +455,21 @@ class SessionState:
                 if e.status is MomentStatus.UNCOMPLETE:
                     await self.send(pk.Pubrel(e.packet_id))
                 else:
+                    # rebuild from the original wire fields; only the expiry
+                    # countdown is refreshed
+                    props = dict(e.wire_props)
+                    rem = e.msg.remaining_expiry()
+                    if rem is not None:
+                        props[P.MESSAGE_EXPIRY_INTERVAL] = rem
                     await self.send(
                         pk.Publish(
                             topic=e.msg.topic,
                             payload=e.msg.payload,
                             qos=e.qos,
                             dup=True,
+                            retain=e.retain,
                             packet_id=e.packet_id,
-                            properties={},
+                            properties=props if self.codec.version == pk.V5 else {},
                         )
                     )
 

@@ -1,0 +1,39 @@
+"""Subprocess TPU-availability probe.
+
+The axon TPU grant can be wedged by a dead client, in which case any
+in-process ``jax.devices()`` blocks forever inside PJRT client init
+(NOTES.md). The default backend must therefore never be touched until
+availability is confirmed from the outside: probe in a throwaway
+subprocess, which can be timed out safely. This is the single home of
+that pattern — ``bench.py`` and ``__graft_entry__`` both use it.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def probe_device_count(timeout: float = 60.0, retries: int = 1,
+                       retry_sleep: float = 15.0) -> int:
+    """Count real devices via a throwaway subprocess; 0 if unreachable."""
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                timeout=timeout,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0:
+                return int(r.stdout.strip().splitlines()[-1])
+        except (subprocess.TimeoutExpired, ValueError, IndexError):
+            pass
+        if attempt + 1 < retries:
+            time.sleep(retry_sleep)
+    return 0
+
+
+def tpu_available(timeout: float = 60.0, retries: int = 2) -> bool:
+    return probe_device_count(timeout=timeout, retries=retries) > 0

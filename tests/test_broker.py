@@ -385,3 +385,52 @@ async def test_outbound_topic_alias_v5(broker):
     await pub.publish("al/other", b"x")
     p = await sub.recv()
     assert p.topic == "al/other"
+
+
+def test_fitter_keepalive_timeout():
+    """The idle deadline must exceed the keepalive so spec-conforming
+    clients pinging at the keepalive interval are never dropped
+    (fitter.rs:158-163: <6s gets +3s slack, else keepalive * backoff * 2)."""
+    from rmqtt_tpu.broker.fitter import Fitter, FitterConfig
+
+    f = Fitter(FitterConfig())
+    assert f.keepalive_timeout(0) == 0.0
+    assert f.keepalive_timeout(3) == 6.0
+    assert f.keepalive_timeout(60) == 90.0
+    for ka in (1, 5, 6, 10, 60, 300, 65535):
+        assert f.keepalive_timeout(ka) > ka
+
+
+@broker_test
+async def test_pipelined_connect_subscribe_publish(broker):
+    """CONNECT+SUBSCRIBE+PUBLISH in one TCP segment (legal without waiting
+    for CONNACK): the trailing packets must not be dropped."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", broker.port)
+    from rmqtt_tpu.broker.codec import MqttCodec
+
+    codec = MqttCodec(pk.V311)
+    burst = (
+        codec.encode(pk.Connect(client_id="pipeliner", protocol=pk.V311))
+        + codec.encode(pk.Subscribe(1, [("pipe/t", SubOpts(qos=1))]))
+        + codec.encode(pk.Publish(topic="pipe/t", payload=b"early", qos=0))
+    )
+    writer.write(burst)
+    await writer.drain()
+    got = {}
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while len(got) < 3:
+        data = await asyncio.wait_for(
+            reader.read(65536), timeout=deadline - asyncio.get_running_loop().time()
+        )
+        assert data, "broker closed the pipelined connection"
+        for p in codec.feed(data):
+            if isinstance(p, pk.Connack):
+                got["connack"] = p
+            elif isinstance(p, pk.Suback):
+                got["suback"] = p
+            elif isinstance(p, pk.Publish):
+                got["publish"] = p
+    assert got["connack"].reason_code == 0
+    assert got["suback"].packet_id == 1
+    assert got["publish"].topic == "pipe/t" and got["publish"].payload == b"early"
+    writer.close()
