@@ -49,7 +49,7 @@ class BrokerConfig:
     shared_subscription: bool = True
     limit_subscription: bool = False  # enable $limit/$exclusive prefixes
     batch_max: int = 1024
-    batch_linger_ms: float = 1.0
+    batch_linger_ms: float = 0.0  # 0 = latency-adaptive (no linger)
     cluster: bool = False  # use a cluster-aware session registry
     cluster_mode: str = "broadcast"  # "broadcast" | "raft"
     # overload protection (reference busy detection, node.rs:212-239 +
@@ -79,6 +79,12 @@ class ServerContext:
                 self.registry.get(cid) is not None and self.registry.get(cid).connected
             )
             if self.cfg.router == "xla":
+                # never hang the broker on a wedged/unreachable accelerator:
+                # honor an explicit cpu request (a sitecustomize preload can
+                # override JAX_PLATFORMS) or probe + fall back (tpuprobe)
+                from rmqtt_tpu.utils.tpuprobe import ensure_safe_platform
+
+                ensure_safe_platform()
                 router = XlaRouter(is_online=online)
             elif self.cfg.router == "native":
                 from rmqtt_tpu.router.native import NativeRouter

@@ -8,6 +8,13 @@ collects up to ``max_batch`` (or until ``linger_ms`` passes) and resolves
 them with ONE ``Router.matches_batch`` call. With ``DefaultRouter`` the batch
 degrades to a loop — the seam is identical, only the router swaps, exactly
 like the reference's extension manager (`rmqtt/src/extend.rs:64-113`).
+
+Batching is latency-adaptive: a dispatch takes whatever is queued RIGHT NOW
+(no linger), so a lone publish at low load pays zero added latency, while
+under load the previous dispatch's service time naturally accumulates the
+next batch (the classic adaptive-batching scheme — batch size tracks load
+with no tuning knob). An optional ``linger_ms > 0`` restores a bounded wait
+for workloads that prefer fuller device batches over first-packet latency.
 """
 
 from __future__ import annotations
@@ -23,7 +30,7 @@ class RoutingService:
         self,
         router: Router,
         max_batch: int = 1024,
-        linger_ms: float = 1.0,
+        linger_ms: float = 0.0,
         max_queue: int = 100_000,
     ) -> None:
         self.router = router
@@ -58,15 +65,21 @@ class RoutingService:
 
     async def _collect(self):
         batch = [await self._q.get()]
-        deadline = asyncio.get_running_loop().time() + self.linger
         while len(batch) < self.max_batch:
-            timeout = deadline - asyncio.get_running_loop().time()
-            if timeout <= 0:
-                break
             try:
-                batch.append(await asyncio.wait_for(self._q.get(), timeout))
-            except asyncio.TimeoutError:
+                batch.append(self._q.get_nowait())
+            except asyncio.QueueEmpty:
                 break
+        if self.linger > 0 and len(batch) < self.max_batch:
+            deadline = asyncio.get_running_loop().time() + self.linger
+            while len(batch) < self.max_batch:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(self._q.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
         return batch
 
     async def _run(self) -> None:

@@ -350,6 +350,11 @@ class SessionState:
         early, self.early_packets = self.early_packets, []
         for p in early:
             await self._handle(p)
+        if early and self.codec.pending_error is not None:
+            # the pipelined CONNECT burst ended in a malformed frame: the
+            # valid packets above were processed first, then close
+            self.ctx.metrics.inc("protocol.errors")
+            return
         while True:
             data = await self.reader.read(65536)
             if not data:

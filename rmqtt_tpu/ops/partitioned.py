@@ -35,7 +35,7 @@ import numpy as np
 from jax import lax
 
 from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
-from rmqtt_tpu.ops.encode import HASH_TOK, PAD_TOK, PLUS_TOK, TokenDict, UNK_TOK
+from rmqtt_tpu.ops.encode import _FIRST_TOK, HASH_TOK, PAD_TOK, PLUS_TOK, TokenDict, UNK_TOK
 
 CHUNK = 128  # rows per partition chunk (4 packed words)
 WORDS_PER_CHUNK = CHUNK // 32
@@ -143,6 +143,9 @@ class PartitionedTable:
         # per-(t0[,t1[,t2]]) candidate-chunk-list caches, invalidated on mutation
         self._cand_cache: Dict[Tuple, np.ndarray] = {}
         self._cand_version = -1
+        # native (C++) encoder: None = not tried yet, False = unavailable
+        self._nenc = None
+        self._nc_cap = 32
 
     # ------------------------------------------------------------- storage
     def _alloc(self, cap_chunks: int, lvl: int) -> None:
@@ -389,6 +392,23 @@ class PartitionedTable:
         self.version += 1
 
     # -------------------------------------------------------- topic encode
+    def _candidates_for(self, levels: Sequence[str]) -> np.ndarray:
+        """Candidate chunk ids for a topic prefix (partition-map walk)."""
+        chunks: List[int] = []
+        seen: set = set()  # partitions share boundary/shared chunks
+        for key in topic_partitions(levels):
+            for cid in self._excl_chunks.get(key, ()):
+                if cid not in seen:
+                    seen.add(cid)
+                    chunks.append(cid)
+            occ = self._shared_chunks_of.get(key)
+            if occ:
+                for cid in occ:
+                    if cid not in seen:
+                        seen.add(cid)
+                        chunks.append(cid)
+        return np.asarray(chunks, dtype=np.int32)
+
     def encode_topics(
         self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
@@ -404,6 +424,15 @@ class PartitionedTable:
             # the RoutingService's executor thread (routing.py dispatches
             # matches_batch_raw via run_in_executor), not the event loop.
             self.compact()
+        if self._nenc is None:
+            try:
+                from rmqtt_tpu.runtime import NativeEncoder
+
+                self._nenc = NativeEncoder()
+            except (RuntimeError, OSError):
+                self._nenc = False
+        if self._nenc:
+            return self._encode_native(topics, pad_batch_to)
         batch = len(topics)
         b = pad_batch_to or batch
         lvl = self.max_levels
@@ -431,31 +460,65 @@ class PartitionedTable:
             ckey = (len(ckey),) + ckey
             cand = cache.get(ckey)
             if cand is None:
-                chunks: List[int] = []
-                seen: set = set()  # partitions share boundary/shared chunks
-                for key in topic_partitions(levels):
-                    for cid in self._excl_chunks.get(key, ()):
-                        if cid not in seen:
-                            seen.add(cid)
-                            chunks.append(cid)
-                    occ = self._shared_chunks_of.get(key)
-                    if occ:
-                        for cid in occ:
-                            if cid not in seen:
-                                seen.add(cid)
-                                chunks.append(cid)
-                cand = np.asarray(chunks, dtype=np.int32)
+                cand = self._candidates_for(levels)
                 cache[ckey] = cand
             per_topic_chunks.append(cand)
         ttok = np.zeros((b, lvl), dtype=np.int32)
         if batch:
             ttok[:batch] = np.asarray(tok_rows, dtype=np.int32)
-        nc = max((len(c) for c in per_topic_chunks), default=1)
-        nc = max(1, 1 << (max(1, nc) - 1).bit_length())  # pow2 bucket
+        mx = max((len(c) for c in per_topic_chunks), default=1)
+        # sticky pow2 NC (grow-only per table): a light batch after a heavy
+        # one must not flip the kernel signature back and forth
+        self._nc_cap = max(self._nc_cap, 1 << (max(1, mx) - 1).bit_length())
+        nc = self._nc_cap
         chunk_ids = np.zeros((b, nc), dtype=np.int32)  # 0 = empty chunk
         for j, chunks in enumerate(per_topic_chunks):
             chunk_ids[j, : len(chunks)] = chunks
         return ttok, tlen, tdollar, chunk_ids, nc
+
+    def _encode_native(
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int]
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+        """C++ hot path for ``encode_topics`` (runtime/encode.cc): tokenize +
+        candidate-cache lookup natively; only distinct-prefix cache misses
+        walk the Python partition maps."""
+        enc = self._nenc
+        batch = len(topics)
+        b = pad_batch_to or batch
+        lvl = self.max_levels
+        toks = self.tokens._strs
+        for i in range(enc.tokens_synced, len(toks)):
+            enc.add_token(toks[i], _FIRST_TOK + i)
+        enc.tokens_synced = len(toks)
+        if enc.cache_version != self.version:
+            enc.cache_clear()
+            enc.cache_version = self.version
+        if batch and any(not isinstance(t, str) for t in topics):
+            topics = [t if isinstance(t, str) else "/".join(t) for t in topics]
+        blob = ("\x00".join(topics) + "\x00").encode() if batch else b"\x00"
+        while True:
+            nc_cap = self._nc_cap
+            ttok = np.zeros((b, lvl), dtype=np.int32)
+            tlen = np.full((b,), -2, dtype=np.int32)
+            tdollar = np.zeros((b,), dtype=np.uint8)
+            cand = np.zeros((b, nc_cap), dtype=np.int32)
+            counts = np.zeros((b,), dtype=np.int32)
+            if batch:
+                miss = enc.encode(
+                    blob, batch, lvl, ttok, tlen, tdollar, nc_cap, cand, counts
+                )
+                for j in miss:
+                    levels = split_levels(topics[j])
+                    chunks = self._candidates_for(levels)
+                    enc.cache_put("/".join(levels[:3]).encode(), chunks)
+                    counts[j] = len(chunks)
+                    cand[j, : min(len(chunks), nc_cap)] = chunks[:nc_cap]
+            mx = int(counts.max(initial=1))
+            nc = max(1, 1 << (max(1, mx) - 1).bit_length())  # pow2 bucket
+            if nc > nc_cap:
+                self._nc_cap = nc  # sticky: grows, never shrinks
+                continue
+            return ttok, tlen, tdollar.view(bool), cand, nc_cap
 
 
 def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_words: int):
@@ -528,16 +591,20 @@ class PartitionedMatcher:
                 if self.device
                 else jax.device_put
             )
-            rows = t.nchunks * CHUNK  # upload only the active prefix
+            # upload the active prefix, padded to a pow2 chunk count (floor
+            # 64) so table growth does not change the device-array shape on
+            # every new chunk — each pow2 bucket costs ONE recompile of the
+            # match kernel, not one per chunk. Padding rows are zeros
+            # (flen=0), which the match formula rejects for every topic.
+            up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
+            rows = t.nchunks * CHUNK
             lvl = t.max_levels
-            packed = np.concatenate(
-                [
-                    t.tok[:rows],
-                    t.flen[:rows, None],
-                    t.prefix_len[:rows, None],
-                    (t.has_hash[:rows].astype(np.int32) | (t.first_wild[:rows] << 1))[:, None],
-                ],
-                axis=1,
+            packed = np.zeros((up_chunks * CHUNK, lvl + 3), dtype=np.int32)
+            packed[:rows, :lvl] = t.tok[:rows]
+            packed[:rows, lvl] = t.flen[:rows]
+            packed[:rows, lvl + 1] = t.prefix_len[:rows]
+            packed[:rows, lvl + 2] = t.has_hash[:rows].astype(np.int32) | (
+                t.first_wild[:rows] << 1
             )
             self._dev_arrays = put(packed.reshape(-1, CHUNK, lvl + 3))
             self._dev_version = t.version
@@ -550,15 +617,15 @@ class PartitionedMatcher:
             topics, pad_batch_to=padded
         )
         dev = self._refresh()
-        max_words = self.max_words
         while True:
             wi, wb, cn = _match_partitioned(
-                dev, ttok, tlen, tdollar, chunk_ids, max_words=max_words
+                dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
             )
             wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
-            if int(cn[:b].max(initial=0)) <= max_words:
+            if int(cn[:b].max(initial=0)) <= self.max_words:
                 break
-            max_words = 1 << (int(cn[:b].max()) - 1).bit_length()  # rare: re-run wider
+            # rare: re-run wider; sticky so later batches skip the narrow run
+            self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
         rows = _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b)
         # physical rows → stable filter ids (rows migrate between chunks)
         fid_map = self.table._fid_of_row

@@ -47,9 +47,9 @@ def load() -> Optional[ctypes.CDLL]:
         return _lib
     if _build_failed:
         return None
-    src = _RUNTIME_DIR / "topics.cc"
-    if not _LIB_PATH.exists() or (
-        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+    srcs = [_RUNTIME_DIR / "topics.cc", _RUNTIME_DIR / "encode.cc"]
+    if not _LIB_PATH.exists() or any(
+        s.exists() and s.stat().st_mtime > _LIB_PATH.stat().st_mtime for s in srcs
     ):
         if not _build():
             _build_failed = True
@@ -73,6 +73,24 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
     lib.rt_trie_match_batch.restype = ctypes.c_int64
+    lib.rt_enc_new.restype = ctypes.c_void_p
+    lib.rt_enc_free.argtypes = [ctypes.c_void_p]
+    lib.rt_enc_add_token.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.rt_enc_cache_clear.argtypes = [ctypes.c_void_p]
+    lib.rt_enc_cache_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.rt_enc_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.rt_enc_encode.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -137,3 +155,64 @@ class NativeTrie:
             rows.append(out[off : off + c].copy())
             off += c
         return rows
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeEncoder:
+    """ctypes wrapper over the C++ batched topic encoder (runtime/encode.cc).
+
+    Owns the native token-dict mirror and candidate-chunk cache for one
+    ``PartitionedTable``; the table syncs tokens incrementally and clears
+    the cache on mutation (see partitioned.py ``_encode_native``).
+    """
+
+    def __init__(self) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no C++ toolchain?)")
+        self._lib = lib
+        self._ptr = ctypes.c_void_p(lib.rt_enc_new())
+        self.tokens_synced = 0  # count of TokenDict entries pushed so far
+        self.cache_version = -1  # table.version the candidate cache reflects
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.rt_enc_free(ptr)
+            self._ptr = None
+
+    def add_token(self, s: str, tid: int) -> None:
+        b = s.encode()
+        self._lib.rt_enc_add_token(self._ptr, b, len(b), tid)
+
+    def cache_clear(self) -> None:
+        self._lib.rt_enc_cache_clear(self._ptr)
+
+    def cache_put(self, key: bytes, chunks: np.ndarray) -> None:
+        chunks = np.ascontiguousarray(chunks, dtype=np.int32)
+        self._lib.rt_enc_cache_put(self._ptr, key, len(key), _i32p(chunks), len(chunks))
+
+    def encode(
+        self,
+        blob: bytes,
+        n: int,
+        max_levels: int,
+        ttok: np.ndarray,
+        tlen: np.ndarray,
+        tdollar: np.ndarray,
+        nc_cap: int,
+        cand: np.ndarray,
+        cand_counts: np.ndarray,
+    ) -> np.ndarray:
+        """Returns the indices of topics whose prefix key missed the cache."""
+        miss = np.empty(n, dtype=np.int32)
+        nmiss = self._lib.rt_enc_encode(
+            self._ptr, blob, n, max_levels,
+            _i32p(ttok), _i32p(tlen),
+            tdollar.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            nc_cap, _i32p(cand), _i32p(cand_counts), _i32p(miss),
+        )
+        return miss[:nmiss]

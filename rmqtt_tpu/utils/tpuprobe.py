@@ -37,3 +37,56 @@ def probe_device_count(timeout: float = 60.0, retries: int = 1,
 
 def tpu_available(timeout: float = 60.0, retries: int = 2) -> bool:
     return probe_device_count(timeout=timeout, retries=retries) > 0
+
+
+_ensured: str | None = None
+
+
+def ensure_safe_platform(probe_timeout: float = 60.0) -> str:
+    """Guard jax-using components against an unreachable accelerator.
+
+    Two failure modes on this class of host (NOTES.md):
+    - a sitecustomize preload force-selects the accelerator platform and
+      OVERRIDES the ``JAX_PLATFORMS`` env var, so ``JAX_PLATFORMS=cpu`` is
+      silently ignored;
+    - the accelerator grant can be wedged, making the first backend touch
+      block forever.
+
+    Policy (memoized per process, must run before the first backend touch):
+    if cpu was explicitly requested (env or jax config), re-apply it; else
+    probe the default backend in a subprocess and force cpu when
+    unreachable. Returns the platform that will be used.
+    """
+    global _ensured
+    if _ensured is not None:
+        return _ensured
+    import os
+
+    import jax
+
+    def _force_cpu() -> None:
+        # a preload may have registered (not initialised) the accelerator
+        # platform already; clear backends or the platform switch is a no-op
+        from jax.extend import backend as _eb
+
+        _eb.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg = (jax.config.jax_platforms or "").split(",")[0]
+    env = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    if "cpu" in (cfg, env):
+        if cfg != "cpu":
+            _force_cpu()
+        _ensured = "cpu"
+    elif probe_device_count(timeout=probe_timeout) == 0:
+        import logging
+
+        logging.getLogger("rmqtt_tpu").warning(
+            "accelerator backend unreachable (subprocess probe timed out); "
+            "forcing jax_platforms=cpu"
+        )
+        _force_cpu()
+        _ensured = "cpu"
+    else:
+        _ensured = cfg or "default"
+    return _ensured

@@ -1,0 +1,133 @@
+// C++ batched publish-topic encoder for the partitioned automaton.
+//
+// Host-side encode (tokenize + candidate-chunk lookup) was the measured
+// bottleneck of the TPU routing path (NOTES.md: 0.064s per 16K topics in
+// Python — at 10x kernel speed the host becomes the wall). This implements
+// the hot loop of rmqtt_tpu/ops/partitioned.py::PartitionedTable.encode_topics
+// natively: split levels, token-dict lookup, $-prefix flag, and the
+// candidate-chunk cache keyed by the topic's first <=3 levels. The cache
+// MISS path (walking the partition maps) stays in Python — it runs once per
+// distinct 3-level prefix, then the result is installed here via
+// rt_enc_cache_put.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). Thread safety:
+// external, same contract as topics.cc.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kUnkTok = 3;  // ops/encode.py UNK_TOK
+constexpr int32_t kPadTok = 0;  // ops/encode.py PAD_TOK
+
+// Heterogeneous hashing: lets find() take a string_view without
+// materializing a std::string per level (the encode loop does one lookup
+// per level per topic — heap allocs there dominated the first version).
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view s) const noexcept {
+    return std::hash<std::string_view>{}(s);
+  }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(std::string_view a, std::string_view b) const noexcept { return a == b; }
+};
+
+struct Encoder {
+  std::unordered_map<std::string, int32_t, SvHash, SvEq> tokens;
+  // first-(<=3)-level topic prefix -> candidate chunk ids
+  std::unordered_map<std::string, std::vector<int32_t>, SvHash, SvEq> cand_cache;
+};
+
+// Key = the raw topic bytes up to (not including) the third '/'. This is
+// exactly partitioned.py's (min(len,3), levels[:3]) tuple key: the slice
+// preserves both the level strings and how many levels (<=3) it covers.
+std::string_view prefix_key(std::string_view topic) {
+  size_t slashes = 0;
+  for (size_t i = 0; i < topic.size(); ++i) {
+    if (topic[i] == '/' && ++slashes == 3) return topic.substr(0, i);
+  }
+  return topic;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_enc_new() { return new Encoder(); }
+
+void rt_enc_free(void* h) { delete static_cast<Encoder*>(h); }
+
+void rt_enc_add_token(void* h, const char* s, int32_t len, int32_t id) {
+  static_cast<Encoder*>(h)->tokens.emplace(std::string(s, static_cast<size_t>(len)), id);
+}
+
+void rt_enc_cache_clear(void* h) { static_cast<Encoder*>(h)->cand_cache.clear(); }
+
+void rt_enc_cache_put(void* h, const char* key, int32_t keylen, const int32_t* chunks,
+                      int32_t n) {
+  auto* enc = static_cast<Encoder*>(h);
+  enc->cand_cache[std::string(key, static_cast<size_t>(keylen))] =
+      std::vector<int32_t>(chunks, chunks + n);
+}
+
+// Encode n '\0'-separated topics. Fills ttok [n, max_levels] (PAD beyond the
+// topic's levels), tlen [n] (full level count), tdollar [n], and for topics
+// whose prefix key is cached: cand [n, nc_cap] (0-padded) + cand_counts [n]
+// (the TRUE count, even when > nc_cap — caller grows nc_cap and retries).
+// Topics with an uncached prefix get cand_counts[j] = -1 and their index
+// appended to miss_idx. Returns the number of misses.
+int64_t rt_enc_encode(void* h, const char* blob, int64_t n, int32_t max_levels,
+                      int32_t* ttok, int32_t* tlen, uint8_t* tdollar, int32_t nc_cap,
+                      int32_t* cand, int32_t* cand_counts, int32_t* miss_idx) {
+  auto* enc = static_cast<Encoder*>(h);
+  const auto& tokens = enc->tokens;
+  const auto& cache = enc->cand_cache;
+  int64_t misses = 0;
+  const char* p = blob;
+  for (int64_t j = 0; j < n; ++j) {
+    const char* topic_start = p;
+    int32_t* row = ttok + j * max_levels;
+    int32_t nlev = 0;
+    const char* lev_start = p;
+    for (;; ++p) {
+      if (*p == '/' || *p == '\0') {
+        if (nlev < max_levels) {
+          auto it = tokens.find(
+              std::string_view(lev_start, static_cast<size_t>(p - lev_start)));
+          row[nlev] = it == tokens.end() ? kUnkTok : it->second;
+        }
+        ++nlev;
+        if (*p == '\0') break;
+        lev_start = p + 1;
+      }
+    }
+    for (int32_t i = nlev; i < max_levels; ++i) row[i] = kPadTok;
+    tlen[j] = nlev;
+    tdollar[j] = topic_start[0] == '$' ? 1 : 0;
+    std::string_view topic(topic_start, static_cast<size_t>(p - topic_start));
+    auto it = cache.find(prefix_key(topic));
+    if (it == cache.end()) {
+      cand_counts[j] = -1;
+      miss_idx[misses++] = static_cast<int32_t>(j);
+    } else {
+      const auto& chunks = it->second;
+      int32_t c = static_cast<int32_t>(chunks.size());
+      cand_counts[j] = c;
+      int32_t w = c < nc_cap ? c : nc_cap;
+      int32_t* out = cand + j * nc_cap;
+      std::memcpy(out, chunks.data(), static_cast<size_t>(w) * sizeof(int32_t));
+      for (int32_t i = w; i < nc_cap; ++i) out[i] = 0;
+    }
+    ++p;  // skip '\0'
+  }
+  return misses;
+}
+
+}  // extern "C"

@@ -128,3 +128,89 @@ def test_deep_filter_and_topic():
     assert r1.tolist() == [f2]
     (r2,) = m.match(["a/" + "/".join(str(i) for i in range(20))])
     assert r2.tolist() == [f1]
+
+
+def test_jit_signature_stability_under_churn():
+    """Table growth/churn must not thrash XLA compiles: device-array chunk
+    counts are pow2-bucketed (floor 64) and NC/B/max_words are pow2-bucketed,
+    so a steady add/remove workload pins a handful of jit signatures."""
+    import random
+
+    from rmqtt_tpu.core.topic import filter_valid
+    from rmqtt_tpu.ops.partitioned import _match_partitioned
+
+    rng = random.Random(7)
+    table = PartitionedTable()
+    matcher = PartitionedMatcher(table)
+    fids = []
+    words = ["a", "b", "c", "d", "e", "+"]
+
+    def add_some(n):
+        while n:
+            levels = [rng.choice(words) for _ in range(rng.randint(1, 5))]
+            if rng.random() < 0.3:
+                levels[-1] = "#"
+            f = "/".join(levels)
+            if filter_valid(f):
+                fids.append(table.add(f))
+                n -= 1
+
+    add_some(200)
+    topics = ["/".join(rng.choice(words[:5]) for _ in range(rng.randint(1, 5))) for _ in range(32)]
+    matcher.match(topics)
+    base = _match_partitioned._cache_size()
+    # churn: interleave adds/removes with matches across many rounds
+    for round_ in range(30):
+        add_some(40)
+        for _ in range(15):
+            fids.remove(f := rng.choice(fids))
+            table.remove(f)
+        matcher.match(
+            ["/".join(rng.choice(words[:5]) for _ in range(rng.randint(1, 5))) for _ in range(32)]
+        )
+    grown = _match_partitioned._cache_size() - base
+    # buckets are sticky + pow2, so signatures grow log-bounded with table
+    # size (the workload grows the table ~7x => a few nc/max_words steps),
+    # never per-round (30 rounds must NOT mean ~30 compiles)
+    assert grown <= 4, f"churn thrashed XLA compiles: {grown} new signatures"
+
+
+def test_native_encode_matches_python_path():
+    """The C++ encoder (runtime/encode.cc) must agree bit-for-bit with the
+    Python encode path on tokens, lengths, $-flags and candidate chunks."""
+    import random
+
+    import numpy as np
+
+    from rmqtt_tpu.core.topic import filter_valid
+
+    rng = random.Random(11)
+    table = PartitionedTable()
+    words = ["a", "b", "c", "", "+", "sensor", "ünïcode"]
+    n = 0
+    while n < 500:
+        levels = [rng.choice(words) for _ in range(rng.randint(1, 6))]
+        if rng.random() < 0.25:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if filter_valid(f):
+            table.add(f)
+            n += 1
+    topics = [
+        "/".join(rng.choice(["a", "b", "c", "", "sensor", "ünïcode", "$sys"]) for _ in range(rng.randint(1, 6)))
+        for _ in range(200)
+    ] + ["$sys/x", "", "a"]
+    native = table.encode_topics(topics, pad_batch_to=256)
+    if table._nenc in (None, False):
+        import pytest
+
+        pytest.skip("native runtime unavailable")
+    # force the pure-python path on the same table
+    table._nenc = False
+    table._cand_cache.clear()
+    table._cand_version = -1
+    py = table.encode_topics(topics, pad_batch_to=256)
+    names = ["ttok", "tlen", "tdollar", "chunk_ids"]
+    for a, b, name in zip(native[:4], py[:4], names):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+    assert native[4] == py[4]
