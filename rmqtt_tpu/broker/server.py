@@ -24,6 +24,7 @@ from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.session import SessionState
 from rmqtt_tpu.broker.types import (
     ConnectInfo,
+    HandshakeLockedError,
     RC_BAD_USERNAME_PASSWORD,
     RC_NOT_AUTHORIZED,
     RC_SUCCESS,
@@ -231,9 +232,16 @@ class MqttBroker:
             await self._refuse(writer, codec, v5, 0x8D, 2)
             return None
         limits = ctx.fitter.fit(ci)
-        session, session_present = await ctx.registry.take_or_create(
-            ctx, id, ci, limits, connect.clean_start
-        )
+        try:
+            session, session_present = await ctx.registry.take_or_create(
+                ctx, id, ci, limits, connect.clean_start
+            )
+        except HandshakeLockedError:
+            # distributed handshake lock held elsewhere (raft mode): refuse
+            # with Server Busy so the client retries (shared.rs:71-106)
+            ctx.metrics.inc("handshake.lock_refused")
+            await self._refuse(writer, codec, v5, 0x89, 3)
+            return None
         # CONNACK (v5.rs:393-409)
         ack_props = {}
         if v5:

@@ -77,6 +77,11 @@ class ConnectInfo:
 
 
 # --- v5 reason codes used by broker paths (MQTT-5.0 2.4) ---
+class HandshakeLockedError(Exception):
+    """Another node holds the distributed handshake lock for this client id
+    (raft mode, reference cluster-raft/src/shared.rs:71-106)."""
+
+
 RC_SUCCESS = 0x00
 RC_NORMAL_DISCONNECT = 0x00
 RC_GRANTED_QOS0 = 0x00

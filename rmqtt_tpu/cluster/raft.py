@@ -6,11 +6,20 @@ independent compact Raft: leader election with randomized timeouts,
 AppendEntries log replication with commit on majority, leader forwarding for
 proposals, and full-log catch-up for (re)joining nodes. Term/vote and the
 log persist to SQLite when a storage is attached (cluster.raft_db), so a
-restarted node reloads and re-applies its own log instead of refetching it;
-log compaction/snapshotting remains a gap (PLAN.md).
+restarted node reloads and re-applies its own log instead of refetching it.
+
+Snapshots + log compaction (Raft §7, mirroring the reference's compressed
+snapshot/restore in `rmqtt-plugins/rmqtt-cluster-raft/src/router.rs:387-580`):
+when the applied prefix exceeds ``compact_threshold`` entries, the node asks
+the application for a full-state snapshot (``snapshot_cb``), compresses it
+(zlib over the wire encoding), persists it, and discards the covered log
+prefix — bounding both the durable log and restart replay. A leader whose
+follower has fallen behind the compacted prefix sends ``raft_snap``
+(InstallSnapshot) instead of AppendEntries; the follower restores via
+``restore_cb`` and resumes replication from the snapshot index.
 
 RPCs ride the cluster transport (`cluster/transport.py`) with message types
-``raft_vote`` / ``raft_append`` / ``raft_propose``.
+``raft_vote`` / ``raft_append`` / ``raft_propose`` / ``raft_snap``.
 """
 
 from __future__ import annotations
@@ -18,8 +27,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import zlib
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from rmqtt_tpu.cluster import wire
 from rmqtt_tpu.cluster.transport import ClusterReplyError, PeerClient, PeerUnavailable
 
 log = logging.getLogger("rmqtt_tpu.raft")
@@ -27,6 +38,15 @@ log = logging.getLogger("rmqtt_tpu.raft")
 RAFT_VOTE = "raft_vote"
 RAFT_APPEND = "raft_append"
 RAFT_PROPOSE = "raft_propose"
+RAFT_SNAP = "raft_snap"
+
+
+def pack_snapshot(data: Any) -> bytes:
+    return zlib.compress(wire.dumps(data))
+
+
+def unpack_snapshot(blob: bytes) -> Any:
+    return wire.loads(zlib.decompress(blob))
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -40,6 +60,9 @@ class RaftNode:
         election_timeout: Tuple[float, float] = (0.3, 0.6),
         heartbeat: float = 0.1,
         storage=None,
+        snapshot_cb: Optional[Callable[[], Any]] = None,
+        restore_cb: Optional[Callable[[Any], Awaitable[None]]] = None,
+        compact_threshold: int = 4096,
     ) -> None:
         self.node_id = node_id
         self.peers = peers
@@ -48,23 +71,40 @@ class RaftNode:
         self.heartbeat = heartbeat
         # optional durable state (SqliteStore): term/vote + the log survive
         # restarts, so a rejoining node re-applies its own log instead of
-        # refetching everything (reference persists via raft snapshots)
+        # refetching everything
         self.storage = storage
+        # snapshot_cb (sync) captures the FULL applied state; restore_cb
+        # replaces local state with a snapshot. Both unset => no compaction.
+        self.snapshot_cb = snapshot_cb
+        self.restore_cb = restore_cb
+        self.compact_threshold = compact_threshold
 
         self.term = 0
         self.voted_for: Optional[int] = None
-        self.log: List[Tuple[int, Any]] = []  # (term, entry)
+        self.log: List[Tuple[int, Any]] = []  # (term, entry), offset by log_offset
+        # log_offset = absolute index of the last snapshot-covered entry;
+        # absolute index i lives at self.log[i - log_offset - 1]
+        self.log_offset = 0
+        self.snap_term = 0  # term at log_offset
+        self._snap_blob: Optional[bytes] = None  # latest compressed snapshot
+        self._pending_restore: Optional[bytes] = None  # loaded, not yet applied
         if storage is not None:
             meta = storage.get("raft", "meta")
             if meta:
                 self.term = int(meta["term"])
                 self.voted_for = meta["voted_for"]
+            snap = storage.get("raft", "snapshot")
+            if snap:
+                self.log_offset = int(snap["index"])
+                self.snap_term = int(snap["term"])
+                self._snap_blob = snap["data"]
+                self._pending_restore = snap["data"]
             rows = sorted(
                 ((int(k), v) for k, v in storage.scan("raft_log")), key=lambda kv: kv[0]
             )
-            self.log = [(int(t), e) for _idx, (t, e) in rows]
-        self.commit_index = 0  # 1-based count of committed entries
-        self.last_applied = 0
+            self.log = [(int(t), e) for idx, (t, e) in rows if idx > self.log_offset]
+        self.commit_index = self.log_offset  # 1-based count of committed entries
+        self.last_applied = self.log_offset
         self.state = FOLLOWER
         self.leader_id: Optional[int] = None
         self._next_index: Dict[int, int] = {}
@@ -74,6 +114,7 @@ class RaftNode:
         self._lead_task: Optional[asyncio.Task] = None
         self._commit_waiters: Dict[int, asyncio.Future] = {}
         self._apply_lock = asyncio.Lock()
+        self._snap_inflight: set = set()  # peers with an InstallSnapshot in flight
         self._stopped = False
 
     # ------------------------------------------------------------ lifecycle
@@ -97,26 +138,75 @@ class RaftNode:
                 pass
         self._tasks = []
 
+    async def restore_pending(self) -> None:
+        """Hand a storage-loaded snapshot to the application. Must run (once)
+        before ``start()`` so log re-apply happens on top of snapshot state."""
+        if self._pending_restore is not None and self.restore_cb is not None:
+            await self.restore_cb(unpack_snapshot(self._pending_restore))
+        self._pending_restore = None
+
+    # --------------------------------------------------- log index helpers
+    def _last_index(self) -> int:
+        return self.log_offset + len(self.log)
+
+    def _term_at(self, idx: int) -> int:
+        if idx <= self.log_offset:
+            return self.snap_term if idx == self.log_offset and idx > 0 else 0
+        return self.log[idx - self.log_offset - 1][0]
+
     def _save_meta(self) -> None:
         if self.storage is not None:
             self.storage.put("raft", "meta", {"term": self.term, "voted_for": self.voted_for})
 
     def _persist_append(self, start_idx: int) -> None:
-        """Persist log entries from 1-based ``start_idx`` to the end — one
-        transaction regardless of batch size (a far-behind follower receives
-        its whole backlog in one AppendEntries)."""
+        """Persist log entries from 1-based absolute ``start_idx`` to the end
+        — one transaction regardless of batch size (a far-behind follower
+        receives its whole backlog in one AppendEntries)."""
         if self.storage is not None:
             self.storage.put_many(
                 "raft_log",
-                [(str(idx), list(self.log[idx - 1]))
-                 for idx in range(start_idx, len(self.log) + 1)],
+                [(str(idx), list(self.log[idx - self.log_offset - 1]))
+                 for idx in range(start_idx, self._last_index() + 1)],
             )
 
-    def _persist_truncate(self, new_len: int) -> None:
+    def _persist_truncate(self, new_last: int) -> None:
+        """Drop persisted entries with absolute index > ``new_last``."""
         if self.storage is not None:
-            idx = new_len + 1
+            idx = new_last + 1
             while self.storage.delete("raft_log", str(idx)):
                 idx += 1
+
+    # ---------------------------------------------------------- compaction
+    def _maybe_compact(self) -> None:
+        """Snapshot applied state + discard the covered log prefix once it
+        outgrows the threshold (router.rs:387-580 semantics: full-state
+        snapshot with compression; filter ids stay stable because the
+        snapshot is of APPLICATION state, not physical layout)."""
+        if self.snapshot_cb is None:
+            return
+        if self.last_applied - self.log_offset < self.compact_threshold:
+            return
+        self.take_snapshot()
+
+    def take_snapshot(self) -> None:
+        """Force a snapshot at ``last_applied`` (also used by tests/admin)."""
+        if self.snapshot_cb is None or self.last_applied <= self.log_offset:
+            return
+        idx = self.last_applied
+        term = self._term_at(idx)
+        blob = pack_snapshot(self.snapshot_cb())
+        self.log = self.log[idx - self.log_offset:]
+        old_offset = self.log_offset
+        self.log_offset = idx
+        self.snap_term = term
+        self._snap_blob = blob
+        if self.storage is not None:
+            self.storage.put("raft", "snapshot", {"index": idx, "term": term, "data": blob})
+            self.storage.delete_int_upto("raft_log", idx)
+        log.info(
+            "raft node %s compacted log through %s (%s entries dropped, snapshot %s bytes)",
+            self.node_id, idx, idx - old_offset, len(blob),
+        )
 
     @property
     def is_leader(self) -> bool:
@@ -137,8 +227,8 @@ class RaftNode:
                 await self._campaign()
 
     async def _request_votes(self, term: int, prevote: bool):
-        last_idx = len(self.log)
-        last_term = self.log[-1][0] if self.log else 0
+        last_idx = self._last_index()
+        last_term = self._term_at(last_idx)
 
         async def ask(peer: PeerClient):
             try:
@@ -210,8 +300,8 @@ class RaftNode:
         # outside the application payload space) so the whole log prefix
         # commits through it
         self.log.append((self.term, None))
-        self._persist_append(len(self.log))
-        nxt = len(self.log) + 1
+        self._persist_append(self._last_index())
+        nxt = self._last_index() + 1
         self._next_index = {nid: nxt for nid in self.peers}
         self._match_index = {nid: 0 for nid in self.peers}
         log.info("raft node %s became leader (term %s)", self.node_id, self.term)
@@ -242,10 +332,15 @@ class RaftNode:
         if self.state != LEADER:
             return
         peer = self.peers[nid]
-        nxt = self._next_index.get(nid, len(self.log) + 1)
+        nxt = self._next_index.get(nid, self._last_index() + 1)
         prev_index = nxt - 1
-        prev_term = self.log[prev_index - 1][0] if prev_index >= 1 and self.log else 0
-        entries = self.log[prev_index:]
+        if prev_index < self.log_offset:
+            # follower is behind the compacted prefix: only a snapshot can
+            # catch it up (Raft §7 InstallSnapshot)
+            await self._send_snapshot(nid)
+            return
+        prev_term = self._term_at(prev_index)
+        entries = self.log[prev_index - self.log_offset:]
         try:
             reply = await peer.call(RAFT_APPEND, {
                 "term": self.term, "leader": self.node_id,
@@ -262,15 +357,42 @@ class RaftNode:
             self._match_index[nid] = prev_index + len(entries)
             self._next_index[nid] = self._match_index[nid] + 1
         else:
-            # follower log diverges/behind: back off (full replay worst case)
+            # follower log diverges/behind: back off (snapshot worst case)
             self._next_index[nid] = max(1, min(nxt - 1, reply.get("match", 0) + 1))
+
+    async def _send_snapshot(self, nid: int) -> None:
+        # at most ONE transfer per peer: the heartbeat loop keeps calling
+        # _replicate while a big snapshot is still on the wire, and duplicate
+        # transfers would multiply bandwidth and re-run restore on the peer
+        if self._snap_blob is None or nid in self._snap_inflight:
+            return
+        self._snap_inflight.add(nid)
+        try:
+            peer = self.peers[nid]
+            body = {
+                "term": self.term, "leader": self.node_id,
+                "index": self.log_offset, "snap_term": self.snap_term,
+                "data": self._snap_blob,
+            }
+            try:
+                reply = await peer.call(RAFT_SNAP, body, timeout=30.0)
+            except (PeerUnavailable, ClusterReplyError):
+                return
+            if reply["term"] > self.term:
+                self._step_down(reply["term"])
+                return
+            if reply.get("success"):
+                self._match_index[nid] = max(self._match_index.get(nid, 0), body["index"])
+                self._next_index[nid] = self._match_index[nid] + 1
+        finally:
+            self._snap_inflight.discard(nid)
 
     def _advance_commit(self) -> None:
         if self.state != LEADER:
             return
-        for idx in range(len(self.log), self.commit_index, -1):
+        for idx in range(self._last_index(), max(self.commit_index, self.log_offset), -1):
             # only entries from the current term commit by counting (Raft §5.4.2)
-            if self.log[idx - 1][0] != self.term:
+            if self._term_at(idx) != self.term:
                 break
             votes = 1 + sum(1 for m in self._match_index.values() if m >= idx)
             if votes >= self._quorum():
@@ -290,7 +412,7 @@ class RaftNode:
         async with self._apply_lock:
             while self.last_applied < self.commit_index:
                 self.last_applied += 1
-                _term, entry = self.log[self.last_applied - 1]
+                _term, entry = self.log[self.last_applied - self.log_offset - 1]
                 if entry is None:
                     pass  # leader-election no-op, not application state
                 else:
@@ -301,6 +423,7 @@ class RaftNode:
                 fut = self._commit_waiters.pop(self.last_applied, None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
+            self._maybe_compact()
 
     # -------------------------------------------------------------- propose
     async def propose(self, entry: Any, timeout: float = 5.0) -> bool:
@@ -312,7 +435,7 @@ class RaftNode:
         while True:
             if self.state == LEADER:
                 self.log.append((self.term, entry))
-                idx = len(self.log)
+                idx = self._last_index()
                 self._persist_append(idx)
                 fut = asyncio.get_running_loop().create_future()
                 self._commit_waiters[idx] = fut
@@ -357,11 +480,13 @@ class RaftNode:
             return self._on_vote(body)
         if mtype == RAFT_APPEND:
             return await self._on_append(body)
+        if mtype == RAFT_SNAP:
+            return await self._on_snapshot(body)
         if mtype == RAFT_PROPOSE:
             if self.state != LEADER:
                 raise ClusterReplyError("not leader")
             self.log.append((self.term, body["entry"]))
-            idx = len(self.log)
+            idx = self._last_index()
             self._persist_append(idx)
             fut = asyncio.get_running_loop().create_future()
             self._commit_waiters[idx] = fut
@@ -375,9 +500,9 @@ class RaftNode:
 
     def _on_vote(self, body: dict) -> dict:
         term = body["term"]
-        my_last_term = self.log[-1][0] if self.log else 0
+        my_last_term = self._term_at(self._last_index())
         up_to_date = (body["last_log_term"], body["last_log_index"]) >= (
-            my_last_term, len(self.log)
+            my_last_term, self._last_index()
         )
         if body.get("prevote"):
             # pre-vote: no state changes; grant iff we'd grant a real vote
@@ -409,18 +534,28 @@ class RaftNode:
         self._last_heartbeat = asyncio.get_running_loop().time()
         prev_index = body["prev_log_index"]
         prev_term = body["prev_log_term"]
-        if prev_index > len(self.log) or (
-            prev_index >= 1 and self.log[prev_index - 1][0] != prev_term
-        ):
+        entries = body["entries"]
+        if prev_index < self.log_offset:
+            # the leader's window overlaps our compacted prefix (possible
+            # right after an InstallSnapshot): entries up to log_offset are
+            # already part of the snapshot — skip them
+            skip = self.log_offset - prev_index
+            if skip >= len(entries):
+                return {"term": self.term, "success": True, "match": self._last_index()}
+            entries = entries[skip:]
+            prev_index = self.log_offset
+            prev_term = self.snap_term
+        if prev_index > self._last_index() or self._term_at(prev_index) != prev_term:
             return {"term": self.term, "success": False, "match": self.commit_index}
         # append, truncating only on an actual conflict (Raft §5.3 — a
         # reordered stale AppendEntries must not clobber newer entries)
         appended_from = None
-        for i, (t, e) in enumerate(body["entries"]):
-            pos = prev_index + i
-            if pos < len(self.log):
-                if self.log[pos][0] != t:
-                    self.log = self.log[:pos]
+        for i, (t, e) in enumerate(entries):
+            pos = prev_index + i  # absolute index of the entry BEFORE this one
+            local = pos - self.log_offset
+            if local < len(self.log):
+                if self.log[local][0] != t:
+                    self.log = self.log[:local]
                     self._persist_truncate(pos)
                     self.log.append((t, e))
                     if appended_from is None:
@@ -432,6 +567,42 @@ class RaftNode:
         if appended_from is not None:
             self._persist_append(appended_from)
         if body["leader_commit"] > self.commit_index:
-            self.commit_index = min(body["leader_commit"], len(self.log))
+            self.commit_index = min(body["leader_commit"], self._last_index())
             await self._apply_committed()
-        return {"term": self.term, "success": True, "match": len(self.log)}
+        return {"term": self.term, "success": True, "match": self._last_index()}
+
+    async def _on_snapshot(self, body: dict) -> dict:
+        """InstallSnapshot (Raft §7): replace local state wholesale."""
+        term = body["term"]
+        if term < self.term:
+            return {"term": self.term, "success": False}
+        if term > self.term:
+            self._step_down(term)
+        elif self.state != FOLLOWER:
+            self.state = FOLLOWER
+        self.leader_id = body["leader"]
+        self._last_heartbeat = asyncio.get_running_loop().time()
+        idx, sterm, blob = body["index"], body["snap_term"], body["data"]
+        if idx <= self.log_offset:
+            return {"term": self.term, "success": True, "match": self._last_index()}
+        async with self._apply_lock:
+            if self.restore_cb is not None:
+                await self.restore_cb(unpack_snapshot(blob))
+            if idx < self._last_index() and self._term_at(idx) == sterm:
+                # our log extends past the snapshot: keep the suffix (§7)
+                self.log = self.log[idx - self.log_offset:]
+            else:
+                self.log = []
+            self.log_offset = idx
+            self.snap_term = sterm
+            self._snap_blob = blob
+            self.last_applied = idx
+            self.commit_index = max(self.commit_index, idx)
+            if self.storage is not None:
+                self.storage.put(
+                    "raft", "snapshot", {"index": idx, "term": sterm, "data": blob}
+                )
+                self.storage.delete_int_upto("raft_log", idx)
+                self._persist_truncate(self._last_index())
+                self._persist_append(self.log_offset + 1)
+        return {"term": self.term, "success": True, "match": self._last_index()}

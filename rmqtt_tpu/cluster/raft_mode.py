@@ -17,14 +17,20 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
-from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.broker.types import HandshakeLockedError, Message
 from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.broadcast import (
     _UNHANDLED,
     ClusterRegistryBase,
     handle_common_message,
 )
-from rmqtt_tpu.cluster.raft import RAFT_APPEND, RAFT_PROPOSE, RAFT_VOTE, RaftNode
+from rmqtt_tpu.cluster.raft import (
+    RAFT_APPEND,
+    RAFT_PROPOSE,
+    RAFT_SNAP,
+    RAFT_VOTE,
+    RaftNode,
+)
 from rmqtt_tpu.cluster.transport import (
     Broadcaster,
     ClusterReplyError,
@@ -36,10 +42,31 @@ from rmqtt_tpu.router.base import Id, SubRelation
 
 log = logging.getLogger("rmqtt_tpu.cluster.raft")
 
+# how long a granted handshake lock shields a client id from a competing
+# connect on another node (the reference's try-lock timeout,
+# cluster-raft/src/shared.rs:71-106)
+HS_LOCK_TTL = 10.0
+
 
 class RaftSessionRegistry(ClusterRegistryBase):
     """Registry whose router mutations go through Raft and whose fan-out
     sends targeted ForwardsTo to subscriber-owning nodes."""
+
+    async def take_or_create(self, ctx, id: Id, connect_info, limits, clean_start: bool):
+        """Serialize concurrent connects of the same client id ACROSS nodes
+        through a raft-replicated handshake lock (shared.rs:71-106
+        HandshakeTryLock) before running the kick/takeover protocol."""
+        c = self.cluster
+        nonce = None
+        if c is not None and c.peers:
+            nonce = await c.handshake_try_lock(id.client_id)
+            if nonce is None:
+                raise HandshakeLockedError(id.client_id)
+        try:
+            return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
+        finally:
+            if nonce is not None:
+                c.handshake_unlock_bg(id.client_id, nonce)
 
     # subscription writes → consensus (router.rs:146-196)
     async def router_add(self, stripped: str, id, opts) -> None:
@@ -175,13 +202,24 @@ class RaftCluster:
             from rmqtt_tpu.storage.sqlite import SqliteStore
 
             storage = SqliteStore(raft_db)
-        self.raft = RaftNode(ctx.node_id, self.peers, self._apply, storage=storage)
+        self.raft = RaftNode(
+            ctx.node_id, self.peers, self._apply, storage=storage,
+            snapshot_cb=self._snapshot_state, restore_cb=self._restore_state,
+        )
         assert isinstance(ctx.registry, RaftSessionRegistry), (
             "raft mode needs ServerContext with registry='raft'"
         )
         ctx.registry.cluster = self
         ctx.retain.on_set = self._on_retain_set
         self._bg_tasks: set = set()
+        # distributed handshake-lock table (part of the replicated state):
+        # client_id -> [node_id, ts, nonce]
+        self.hs_locks: Dict[str, list] = {}
+        self._hs_results: Dict[str, bool] = {}
+        # nonces a local handshake is still awaiting; _apply only records
+        # results for these (a lock entry committing after its proposer gave
+        # up must not leave an orphan result behind)
+        self._hs_pending: set = set()
 
     @property
     def bound_port(self) -> int:
@@ -189,6 +227,9 @@ class RaftCluster:
 
     async def start(self) -> None:
         await self.server.start()
+        # a storage-loaded snapshot must hit the router BEFORE the log
+        # re-applies on top of it
+        await self.raft.restore_pending()
         self.raft.start()
 
     async def start_sync(self) -> None:
@@ -223,8 +264,96 @@ class RaftCluster:
         elif op == "remove_many":
             for tf, node, client in entry["items"]:
                 self.ctx.router.remove(tf, Id(node, client))
+        elif op == "hs_lock":
+            # deterministic across nodes: decided purely from entry fields
+            # and the replicated lock table, in log order. The TTL staleness
+            # check compares proposer wall clocks — deterministic, but like
+            # the reference's timeout-based try-lock it assumes roughly
+            # NTP-synced cluster clocks (skew > HS_LOCK_TTL could steal a
+            # live lock or delay breaking a dead one).
+            cur = self.hs_locks.get(entry["client"])
+            granted = (
+                cur is None
+                or entry["ts"] - cur[1] > HS_LOCK_TTL  # stale holder (crashed mid-handshake)
+                or cur[0] == entry["node"]  # re-entrant on the same node
+            )
+            if granted:
+                self.hs_locks[entry["client"]] = [entry["node"], entry["ts"], entry["nonce"]]
+            if entry["node"] == self.ctx.node_id and entry["nonce"] in self._hs_pending:
+                self._hs_results[entry["nonce"]] = granted
+        elif op == "hs_unlock":
+            # nonce-scoped: releasing one handshake's lock must not release
+            # a newer re-entrant lock for the same client on the same node
+            cur = self.hs_locks.get(entry["client"])
+            if cur is not None and cur[0] == entry["node"] and cur[2] == entry["nonce"]:
+                del self.hs_locks[entry["client"]]
         else:
             log.warning("unknown raft entry %r", op)
+
+    # -------------------------------------------------- snapshot callbacks
+    def _snapshot_state(self):
+        """Full replicated state for raft compaction (router.rs:387-460
+        snapshot of relations + client states): every route edge plus the
+        handshake-lock table."""
+        routes = [
+            [tf, sid.node_id, sid.client_id, M.opts_to_wire(opts)]
+            for tf, sid, opts in self.ctx.router.dump_routes()
+        ]
+        return {
+            "routes": routes,
+            "hs_locks": {cid: list(v) for cid, v in self.hs_locks.items()},
+        }
+
+    async def _restore_state(self, snap) -> None:
+        """Replace local replicated state with a snapshot (router.rs:462-580
+        restore path): clear relations, re-add every route."""
+        router = self.ctx.router
+        existing = [(tf, sid) for tf, sid, _o in list(router.dump_routes())]
+        for tf, sid in existing:
+            router.remove(tf, sid)
+        for tf, node, client, opts in snap.get("routes", []):
+            router.add(tf, Id(node, client), M.opts_from_wire(opts))
+        self.hs_locks = {cid: list(v) for cid, v in snap.get("hs_locks", {}).items()}
+        log.info(
+            "raft node %s restored snapshot: %s routes, %s handshake locks",
+            self.ctx.node_id, len(snap.get("routes", [])), len(self.hs_locks),
+        )
+
+    # -------------------------------------------------- handshake lock API
+    async def handshake_try_lock(self, client_id: str, timeout: float = 5.0) -> Optional[str]:
+        """Raft-replicated HandshakeTryLock (shared.rs:71-106): exactly one
+        node in the cluster wins the right to handshake ``client_id``.
+        Returns the lock nonce on success (pass it to unlock), else None."""
+        import time as _time
+        import uuid as _uuid
+
+        nonce = _uuid.uuid4().hex
+        entry = {
+            "op": "hs_lock", "client": client_id, "node": self.ctx.node_id,
+            "nonce": nonce, "ts": _time.time(),
+        }
+        self._hs_pending.add(nonce)
+        try:
+            if not await self.raft.propose(entry, timeout=timeout):
+                # the entry may still commit later; compensate so an
+                # unobserved late grant cannot orphan the lock until TTL
+                self._hs_results.pop(nonce, None)
+                self.handshake_unlock_bg(client_id, nonce)
+                return None
+            return nonce if self._hs_results.pop(nonce, False) else None
+        finally:
+            self._hs_pending.discard(nonce)
+
+    def handshake_unlock_bg(self, client_id: str, nonce: str) -> None:
+        entry = {
+            "op": "hs_unlock", "client": client_id,
+            "node": self.ctx.node_id, "nonce": nonce,
+        }
+        task = asyncio.get_running_loop().create_task(
+            self.raft.propose(entry, timeout=30.0)
+        )
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
         async def push():
@@ -239,7 +368,7 @@ class RaftCluster:
 
     # -------------------------------------------------------------- inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
-        if mtype in (RAFT_VOTE, RAFT_APPEND, RAFT_PROPOSE):
+        if mtype in (RAFT_VOTE, RAFT_APPEND, RAFT_PROPOSE, RAFT_SNAP):
             return await self.raft.on_message(mtype, body)
         if mtype == M.PING:
             return {"pong": True, "leader": self.raft.leader_id, "term": self.raft.term}

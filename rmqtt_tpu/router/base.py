@@ -122,6 +122,15 @@ class Router(abc.ABC):
         return [self.collapse(raw) for raw in self.matches_batch_raw(items)]
 
     # --- admin / introspection surface (router.rs gets/query/topics) ---
+    def dump_routes(self):
+        """Every route edge as (topic_filter, Id, opts) — snapshot/transfer
+        surface (raft compaction serializes the full table through this).
+        Default walks the ``_relations`` map all bundled routers keep; a
+        router with a different store must override."""
+        for tf, rels in self._relations.items():
+            for _cid, (sid, opts) in rels.items():
+                yield tf, sid, opts
+
     @abc.abstractmethod
     def gets(self, limit: int) -> List[dict]:
         """List (topic_filter, client) routes up to limit."""

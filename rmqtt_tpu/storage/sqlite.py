@@ -74,6 +74,15 @@ class SqliteStore:
         self._db.commit()
         return cur.rowcount > 0
 
+    def delete_int_upto(self, ns: str, n: int) -> int:
+        """Delete every key whose integer value is <= n (raft log compaction:
+        keys are 1-based absolute log indices)."""
+        cur = self._db.execute(
+            "DELETE FROM kv WHERE ns = ? AND CAST(k AS INTEGER) <= ?", (ns, n)
+        )
+        self._db.commit()
+        return cur.rowcount
+
     def scan(self, ns: str) -> List[Tuple[str, Any]]:
         nw = time.time()
         rows = self._db.execute(

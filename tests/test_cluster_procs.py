@@ -1,0 +1,149 @@
+"""Multi-PROCESS cluster tests: 3 real ``python -m rmqtt_tpu.broker``
+processes wired as a raft cluster over real TCP, driven black-box through
+their listeners — the reference's multi-node test stance
+(`rmqtt-test/src/main.rs:1-120`, examples/cluster-raft-3). Includes
+process-kill chaos: a node is SIGTERM'd mid-traffic and the survivors must
+keep routing; a replacement rejoins and catches up via raft.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.mqtt_client import TestClient
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn_node(node_id: int, port: int, cport: int, peers: list) -> subprocess.Popen:
+    cmd = [
+        sys.executable, "-m", "rmqtt_tpu.broker",
+        "--port", str(port), "--node-id", str(node_id),
+        "--cluster-listen", f"127.0.0.1:{cport}", "--cluster-mode", "raft",
+    ]
+    for nid, pport in peers:
+        cmd += ["--peer", f"{nid}@127.0.0.1:{pport}"]
+    return subprocess.Popen(
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True
+    )
+
+
+def _wait_port(port: int, timeout: float = 15.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never opened")
+
+
+def test_three_process_cluster_with_chaos():
+    mports = _free_ports(4)  # mqtt ports (4th for the rejoining node)
+    cports = _free_ports(4)  # cluster rpc ports
+    procs = {}
+
+    def spawn(i):  # i in 1..3 (node 4 reuses node 3's slots)
+        slot = i - 1 if i <= 3 else 2
+        peers = [(j, cports[j - 1]) for j in (1, 2, 3) if j != min(i, 3)]
+        procs[i] = _spawn_node(i if i <= 3 else 3, mports[slot], cports[slot], peers)
+
+    async def drive():
+        sub = await TestClient.connect(mports[0], "proc-sub")
+        ack = await sub.subscribe("pc/+/t", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        pub = await TestClient.connect(mports[1], "proc-pub")
+
+        async def publish_until_delivered(topic, payload, timeout=10.0):
+            """Cross-node route visibility is eventual: retry the publish
+            until the subscriber sees it (dedup by payload)."""
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                await pub.publish(topic, payload, qos=1)
+                try:
+                    p = await sub.recv(timeout=1.0)
+                    while p.payload != payload:
+                        p = await sub.recv(timeout=1.0)
+                    return p
+                except asyncio.TimeoutError:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        f"{payload} never delivered"
+                    )
+
+        await publish_until_delivered("pc/a/t", b"m-before")
+
+        # ---- chaos: SIGTERM node 3 mid-traffic; survivors keep routing
+        procs[3].send_signal(signal.SIGTERM)
+        procs[3].wait(timeout=10)
+        await publish_until_delivered("pc/b/t", b"m-after-kill")
+
+        # ---- a replacement node (same id/ports) rejoins and catches up
+        spawn(4)
+        _wait_port(mports[2])
+        sub3 = await TestClient.connect(mports[2], "proc-sub3")
+        ack = await sub3.subscribe("pc/rejoin/#", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        deadline = asyncio.get_running_loop().time() + 15.0
+        while True:
+            await pub.publish("pc/rejoin/x", b"to-newbie", qos=1)
+            try:
+                p = await sub3.recv(timeout=1.0)
+                assert p.payload == b"to-newbie"
+                break
+            except asyncio.TimeoutError:
+                assert asyncio.get_running_loop().time() < deadline, "rejoined node never caught up"
+
+        # ---- cross-process kick: same client id on another node
+        dup = await TestClient.connect(mports[1], "proc-sub")
+        await asyncio.sleep(0.5)
+        assert dup.connack.reason_code == 0
+        try:
+            await asyncio.wait_for(sub.closed.wait(), timeout=5.0)
+        except asyncio.TimeoutError:
+            raise AssertionError("old session was not kicked across processes")
+        await dup.close()
+        await sub3.close()
+        await pub.close()
+
+    try:
+        for i in (1, 2, 3):
+            spawn(i)
+        for p in mports[:3]:
+            _wait_port(p)
+        asyncio.run(asyncio.wait_for(drive(), timeout=90.0))
+    finally:
+        errs = {}
+        for i, proc in procs.items():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for i, proc in procs.items():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            if proc.stderr is not None:
+                tail = proc.stderr.read()[-2000:]
+                if tail:
+                    errs[i] = tail
+        # broker processes must exit cleanly on SIGTERM (no tracebacks)
+        for i, tail in errs.items():
+            assert "Traceback" not in tail, f"node {i} stderr:\n{tail}"

@@ -18,7 +18,7 @@ from rmqtt_tpu.cluster.transport import PeerClient
 from tests.mqtt_client import TestClient
 
 
-async def make_raft_cluster(n=3):
+async def make_raft_cluster(n=3, raft_dbs=None, compact_threshold=None):
     brokers = []
     for i in range(n):
         ctx = ServerContext(BrokerConfig(port=0, node_id=i + 1, cluster=True,
@@ -27,9 +27,13 @@ async def make_raft_cluster(n=3):
         await b.start()
         brokers.append(b)
     clusters = []
-    for b in brokers:
-        c = RaftCluster(b.ctx, ("127.0.0.1", 0), [])
+    for i, b in enumerate(brokers):
+        c = RaftCluster(b.ctx, ("127.0.0.1", 0), [],
+                        raft_db=raft_dbs[i] if raft_dbs else None)
+        if compact_threshold is not None:
+            c.raft.compact_threshold = compact_threshold
         await c.server.start()
+        await c.raft.restore_pending()
         clusters.append(c)
     for i, c in enumerate(clusters):
         for j, other in enumerate(clusters):
@@ -235,3 +239,151 @@ def test_raft_log_persistence(tmp_path):
         store2.close()
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_snapshot_compaction_and_late_joiner():
+    """Leader compacts its log via application snapshots; a node joining
+    after compaction catches up via InstallSnapshot instead of full replay
+    (router.rs:387-580, Raft §7)."""
+
+    async def run():
+        brokers, clusters = await make_raft_cluster(3, compact_threshold=60)
+        try:
+            leader = await wait_leader(clusters)
+            from rmqtt_tpu.router.base import SubscriptionOptions
+            from rmqtt_tpu.cluster import messages as M
+
+            opts = M.opts_to_wire(SubscriptionOptions(qos=1))
+            for i in range(200):
+                ok = await leader.raft.propose(
+                    {"op": "add", "tf": f"snap/t{i}", "node": 1,
+                     "client": f"c{i}", "opts": opts}
+                )
+                assert ok
+            assert leader.raft.log_offset > 0, "no compaction happened"
+            assert len(leader.raft.log) < 200
+            # every existing node converges to the full table (follower
+            # applies ride commit propagation)
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if all(b.ctx.router.routes_count() == 200 for b in brokers):
+                    break
+                await asyncio.sleep(0.1)
+            for b in brokers:
+                assert b.ctx.router.routes_count() == 200, (
+                    b.ctx.node_id, b.ctx.router.routes_count())
+
+            # late joiner: a fresh 4th node, empty log — must arrive via
+            # snapshot (its catch-up window starts before leader.log_offset)
+            ctx4 = ServerContext(BrokerConfig(port=0, node_id=4, cluster=True,
+                                              cluster_mode="raft"))
+            b4 = MqttBroker(ctx4)
+            await b4.start()
+            c4 = RaftCluster(ctx4, ("127.0.0.1", 0), [])
+            await c4.server.start()
+            for b, c in zip(brokers, clusters):
+                nid = b.ctx.node_id
+                c4.peers[nid] = PeerClient(nid, "127.0.0.1", c.bound_port)
+                c.peers[4] = PeerClient(4, "127.0.0.1", c4.bound_port)
+                c.bcast.peers = list(c.peers.values())
+            c4.bcast.peers = list(c4.peers.values())
+            c4.raft.start()
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if ctx4.router.routes_count() == 200:
+                    break
+                await asyncio.sleep(0.1)
+            assert ctx4.router.routes_count() == 200, ctx4.router.routes_count()
+            assert c4.raft.log_offset >= leader.raft.log_offset  # snapshot install, not replay
+            brokers.append(b4)
+            clusters.append(c4)
+        finally:
+            await teardown(brokers, clusters)
+
+    asyncio.run(run())
+
+
+def test_restart_from_snapshot(tmp_path):
+    """A restarted node reloads snapshot + log tail from sqlite: full state,
+    bounded replay (the durable log stays short after compaction)."""
+
+    async def run():
+        db = str(tmp_path / "raft1.db")
+        brokers, clusters = await make_raft_cluster(1, raft_dbs=[db], compact_threshold=50)
+        from rmqtt_tpu.router.base import SubscriptionOptions
+        from rmqtt_tpu.cluster import messages as M
+
+        opts = M.opts_to_wire(SubscriptionOptions(qos=0))
+        try:
+            for i in range(120):
+                assert await clusters[0].raft.propose(
+                    {"op": "add", "tf": f"dur/t{i}", "node": 1,
+                     "client": f"c{i}", "opts": opts}
+                )
+            assert clusters[0].raft.log_offset > 0
+        finally:
+            await teardown(brokers, clusters)
+
+        # restart with the same db: snapshot restores the router without
+        # replaying the full 120-entry history
+        brokers2, clusters2 = await make_raft_cluster(1, raft_dbs=[db])
+        try:
+            r = clusters2[0].raft
+            assert r.log_offset > 0
+            assert len(r.log) < 120
+            assert brokers2[0].ctx.router.routes_count() >= r.log_offset - 1
+            # the log tail re-applies on commit; wait for leadership + apply
+            deadline = asyncio.get_running_loop().time() + 8
+            while asyncio.get_running_loop().time() < deadline:
+                if brokers2[0].ctx.router.routes_count() == 120:
+                    break
+                await asyncio.sleep(0.1)
+            assert brokers2[0].ctx.router.routes_count() == 120
+        finally:
+            await teardown(brokers2, clusters2)
+
+    asyncio.run(run())
+
+
+@raft_test
+async def test_handshake_lock_single_winner(brokers, clusters):
+    """Concurrent connects of one client id on two nodes: the raft handshake
+    lock serializes them (shared.rs:71-106) — exactly one live session
+    remains, and the loser is refused or cleanly kicked, never duplicated."""
+    await wait_leader(clusters)
+    b1, b2 = brokers[0], brokers[1]
+    c1, c2 = clusters[0], clusters[1]
+    # direct lock API: one winner while held
+    got1 = await c1.handshake_try_lock("dup-client")
+    got2 = await c2.handshake_try_lock("dup-client")
+    assert got1 is not None and got2 is None
+    c1.handshake_unlock_bg("dup-client", got1)
+    deadline = asyncio.get_running_loop().time() + 5
+    while asyncio.get_running_loop().time() < deadline:
+        got2 = await c2.handshake_try_lock("dup-client")
+        if got2 is not None:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise AssertionError("lock never released")
+    c2.handshake_unlock_bg("dup-client", got2)
+
+    # full stack: simultaneous MQTT connects on two brokers
+    async def try_connect(broker):
+        try:
+            c = await TestClient.connect(broker.port, "racer", version=pk.V311)
+            return c
+        except Exception:
+            return None
+
+    results = await asyncio.gather(*(try_connect(b) for b in (b1, b2, b1, b2)))
+    await asyncio.sleep(1.0)
+    live = [
+        b.ctx.registry.get("racer")
+        for b in brokers
+        if b.ctx.registry.get("racer") is not None and b.ctx.registry.get("racer").connected
+    ]
+    assert len(live) == 1, f"{len(live)} live sessions for one client id"
+    for c in results:
+        if c is not None:
+            await c.close()
