@@ -74,6 +74,8 @@ class ServerContext:
         self.cfg = cfg or BrokerConfig()
         self.hooks = HookRegistry()
         self.metrics = Metrics()
+        # v5 enhanced-auth seam (broker/auth.py); None = AUTH methods refused
+        self.enhanced_auth = None
         if router is None:
             online = lambda cid: (
                 self.registry.get(cid) is not None and self.registry.get(cid).connected

@@ -167,7 +167,7 @@ class MqttBroker:
                 writer.close()
                 return
             connect, early = got
-            state = await self._handshake(connect, reader, writer, codec, peer)
+            state = await self._handshake(connect, reader, writer, codec, peer, early)
         finally:
             ctx.handshaking -= 1
         if state is not None:
@@ -177,23 +177,30 @@ class MqttBroker:
             finally:
                 ctx.metrics.inc("connections.closed")
 
-    async def _read_connect(self, reader, codec):
-        """Returns (Connect, trailing packets) or None. Clients may legally
-        pipeline SUBSCRIBE/PUBLISH behind CONNECT in one TCP segment without
-        waiting for CONNACK; trailing packets decoded from the same feed are
-        replayed into the session read loop after the handshake."""
+    async def _read_first(self, reader, codec):
+        """Read until at least one packet decodes; → (first, trailing) or
+        None on EOF. Trailing packets a client pipelined into the same TCP
+        segment are preserved for replay, never dropped."""
         while True:
             data = await reader.read(65536)
             if not data:
                 return None
             packets = codec.feed(data)
             if packets:
-                p = packets[0]
-                if not isinstance(p, pk.Connect):
-                    return None
-                return p, packets[1:]
+                return packets[0], packets[1:]
 
-    async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer):
+    async def _read_connect(self, reader, codec):
+        """Returns (Connect, trailing packets) or None. Clients may legally
+        pipeline SUBSCRIBE/PUBLISH behind CONNECT in one TCP segment without
+        waiting for CONNACK; trailing packets decoded from the same feed are
+        replayed into the session read loop after the handshake."""
+        got = await self._read_first(reader, codec)
+        if got is None or not isinstance(got[0], pk.Connect):
+            return None
+        return got
+
+    async def _handshake(self, connect: pk.Connect, reader, writer, codec, peer,
+                         early: Optional[list] = None):
         """v5.rs `_handshake` :191-410 (v3 mirror). Returns the ready
         SessionState (caller runs it), or None if refused."""
         ctx = self.ctx
@@ -218,9 +225,28 @@ class MqttBroker:
             will=connect.will,
         )
         await ctx.hooks.fire(HookType.CLIENT_CONNECT, ci, None, None)
+        # v5 enhanced authentication (spec §4.12, codec auth.rs): a CONNECT
+        # carrying an Authentication Method runs the AUTH challenge loop
+        # BEFORE basic auth; its success replaces the password check
+        auth_method = connect.properties.get(P.AUTHENTICATION_METHOD) if v5 else None
+        enhanced_ok = False
+        auth_final_data = None
+        if auth_method is not None:
+            rc, auth_final_data = await self._auth_exchange(
+                ci, auth_method, connect.properties.get(P.AUTHENTICATION_DATA),
+                reader, writer, codec, early if early is not None else [],
+            )
+            if rc != RC_SUCCESS:
+                ctx.metrics.inc("auth.failures")
+                if rc >= 0:
+                    await self._refuse(writer, codec, True, rc, 2)
+                else:
+                    writer.close()
+                return None
+            enhanced_ok = True
         # authenticate (client_authenticate hook; default allows anonymous
         # per config — auth plugins override via higher-priority handlers)
-        default_auth = ctx.cfg.allow_anonymous or ci.username is not None
+        default_auth = enhanced_ok or ctx.cfg.allow_anonymous or ci.username is not None
         allowed = await ctx.hooks.fire(HookType.CLIENT_AUTHENTICATE, ci, None, initial=default_auth)
         if not allowed:
             ctx.metrics.inc("auth.failures")
@@ -258,6 +284,12 @@ class MqttBroker:
             )
             ack_props[P.MAXIMUM_QOS] = ctx.cfg.max_qos
             ack_props[P.MAXIMUM_PACKET_SIZE] = ctx.cfg.max_packet_size
+        if auth_method is not None:
+            # the CONNACK of a successful enhanced auth echoes the method and
+            # carries any server-final data (e.g. SCRAM server proof)
+            ack_props[P.AUTHENTICATION_METHOD] = auth_method
+            if auth_final_data is not None:
+                ack_props[P.AUTHENTICATION_DATA] = auth_final_data
         reason = await ctx.hooks.fire(
             HookType.CLIENT_CONNACK, ci, session_present, initial=RC_SUCCESS
         )
@@ -291,6 +323,43 @@ class MqttBroker:
         ctx.metrics.inc("connections.established")
         await ctx.hooks.fire(HookType.CLIENT_CONNECTED, ci, None, None)
         return state
+
+    async def _auth_exchange(self, ci, method, data, reader, writer, codec, early: list):
+        """Run the server side of the AUTH challenge loop. Returns
+        (reason_code, server_final_data): 0x00 accept, failure codes refuse,
+        -1 = close without CONNACK. Packets the client pipelined behind its
+        AUTH replies are appended to ``early`` for session replay."""
+        from rmqtt_tpu.broker import auth as ea
+
+        authenticator = self.ctx.enhanced_auth
+        if authenticator is None:
+            return ea.RC_BAD_AUTHENTICATION_METHOD, None
+        try:
+            rc, out = await authenticator.start(ci, method, data)
+            while rc == ea.RC_CONTINUE_AUTHENTICATION:
+                props = {P.AUTHENTICATION_METHOD: method}
+                if out is not None:
+                    props[P.AUTHENTICATION_DATA] = out
+                writer.write(codec.encode(pk.Auth(rc, props)))
+                await writer.drain()
+                got = await asyncio.wait_for(
+                    self._read_first(reader, codec), timeout=self.ctx.cfg.max_handshake_delay
+                )
+                if got is None:
+                    return -1, None
+                reply, rest = got
+                early.extend(rest)
+                if (
+                    not isinstance(reply, pk.Auth)
+                    or reply.properties.get(P.AUTHENTICATION_METHOD) != method
+                ):
+                    return 0x82, None  # Protocol Error: non-AUTH / method switch
+                rc, out = await authenticator.continue_(
+                    ci, method, reply.properties.get(P.AUTHENTICATION_DATA)
+                )
+            return rc, out
+        except (asyncio.TimeoutError, ConnectionError, OSError, ProtocolViolation):
+            return -1, None
 
     async def _refuse(self, writer, codec, v5: bool, rc5: int, rc3: int) -> None:
         try:

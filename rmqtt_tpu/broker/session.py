@@ -527,10 +527,44 @@ class SessionState:
             self._disconnect_reason = p.reason_code
             self._closing.set()
         elif isinstance(p, pk.Auth):
-            pass  # enhanced auth not supported yet
+            await self._on_auth(p)
         elif isinstance(p, pk.Connect):
             # second CONNECT is a protocol error (MQTT-3.1.0-2)
             self._closing.set()
+
+    async def _on_auth(self, p: pk.Auth) -> None:
+        """v5 re-authentication over the live connection (spec §4.12: client
+        AUTH 0x19 starts, 0x18 continues; server answers AUTH until 0x00
+        Success or disconnects with the failure code)."""
+        from rmqtt_tpu.broker import auth as ea
+
+        s = self.s
+        method = p.properties.get(P.AUTHENTICATION_METHOD)
+        original = s.connect_info.properties.get(P.AUTHENTICATION_METHOD)
+        authenticator = self.ctx.enhanced_auth
+        if (
+            authenticator is None
+            or method is None
+            or method != original  # method must not change mid-session (§4.12)
+        ):
+            await self._disconnect_with(ea.RC_BAD_AUTHENTICATION_METHOD)
+            return
+        data = p.properties.get(P.AUTHENTICATION_DATA)
+        if p.reason_code == ea.RC_RE_AUTHENTICATE:
+            rc, out = await authenticator.start(s.connect_info, method, data)
+        elif p.reason_code == ea.RC_CONTINUE_AUTHENTICATION:
+            rc, out = await authenticator.continue_(s.connect_info, method, data)
+        else:
+            await self._disconnect_with(0x82)  # protocol error
+            return
+        if rc in (ea.RC_AUTH_SUCCESS, ea.RC_CONTINUE_AUTHENTICATION):
+            props = {P.AUTHENTICATION_METHOD: method}
+            if out is not None:
+                props[P.AUTHENTICATION_DATA] = out
+            await self.send(pk.Auth(rc, props))
+        else:
+            self.ctx.metrics.inc("auth.failures")
+            await self._disconnect_with(rc)
 
     # -------------------------------------------------------------- publish
     async def _on_publish(self, p: pk.Publish) -> None:

@@ -171,6 +171,7 @@ PLUGIN_REGISTRY: Dict[str, str] = {
     "rmqtt-web-hook": "rmqtt_tpu.plugins.web_hook:WebHookPlugin",
     "rmqtt-auth-http": "rmqtt_tpu.plugins.auth_http:AuthHttpPlugin",
     "rmqtt-auth-jwt": "rmqtt_tpu.plugins.auth_jwt:AuthJwtPlugin",
+    "rmqtt-auth-cram": "rmqtt_tpu.plugins.auth_cram:AuthCramPlugin",
     "rmqtt-session-storage": "rmqtt_tpu.plugins.session_storage:SessionStoragePlugin",
     "rmqtt-message-storage": "rmqtt_tpu.plugins.message_storage:MessageStoragePlugin",
     "rmqtt-retainer": "rmqtt_tpu.plugins.retainer:RetainerPlugin",

@@ -37,6 +37,8 @@ class TestClient:
         self.auto_ack = True
         self.closed = asyncio.Event()
         self._alias_map = {}
+        # enhanced auth (v5): called with (client, Auth packet) on every AUTH
+        self.auth_handler = None
 
     # ------------------------------------------------------------- connect
     @classmethod
@@ -52,10 +54,12 @@ class TestClient:
         will: Optional[pk.Will] = None,
         properties: Optional[dict] = None,
         host: str = "127.0.0.1",
+        auth_handler=None,
     ) -> "TestClient":
         reader, writer = await asyncio.open_connection(host, port)
         codec = MqttCodec(version)
         client = cls(reader, writer, codec, version)
+        client.auth_handler = auth_handler
         writer.write(
             codec.encode(
                 pk.Connect(
@@ -141,8 +145,13 @@ class TestClient:
             self._resolve(("unsuback", p.packet_id), p)
         elif isinstance(p, pk.Pingresp):
             self._resolve(("pingresp",), p)
+        elif isinstance(p, pk.Auth):
+            self._resolve(("auth", p.reason_code), p)
+            if self.auth_handler is not None:
+                await self.auth_handler(self, p)
         elif isinstance(p, pk.Disconnect):
             self.disconnect = p
+            self._resolve(("disconnect",), p)
 
     async def _send(self, p) -> None:
         self.writer.write(self.codec.encode(p))
