@@ -33,6 +33,12 @@ class BrokerConfig:
     wss_port: Optional[int] = None
     tls_cert: str = ""
     tls_key: str = ""
+    # require + verify client certificates against this CA bundle; the cert's
+    # CN/O/subject/serial land in ConnectInfo.cert_info (cert_extractor.rs)
+    tls_client_ca: str = ""
+    # PROXY protocol v1/v2 on the non-TLS listeners (builder.rs:152,466-474):
+    # the advertised source replaces the socket peer address
+    proxy_protocol: bool = False
     node_id: int = 1
     router: str = "trie"  # "trie" (DefaultRouter) | "xla" (TPU)
     allow_anonymous: bool = True

@@ -37,6 +37,36 @@ from rmqtt_tpu.router.base import Id
 
 log = logging.getLogger("rmqtt_tpu.broker")
 
+_UNSET = object()  # sentinel: _on_connection called as the raw listener callback
+
+
+def extract_cert_info(writer):
+    """TLS client-certificate metadata from the connection, if any
+    (cert_extractor.rs semantics over stdlib ssl: populated only when the
+    listener verifies client certs)."""
+    from rmqtt_tpu.broker.types import CertInfo
+
+    ssl_obj = writer.get_extra_info("ssl_object")
+    if ssl_obj is None:
+        return None
+    try:
+        cert = ssl_obj.getpeercert()
+    except ValueError:
+        return None
+    if not cert:
+        return None
+    fields = {}
+    for rdn in cert.get("subject", ()):  # ((('commonName','x'),), ...)
+        for key, value in rdn:
+            fields.setdefault(key, value)
+    subject = ",".join(f"{k}={v}" for rdn in cert.get("subject", ()) for k, v in rdn)
+    return CertInfo(
+        common_name=fields.get("commonName"),
+        subject=subject or None,
+        serial=cert.get("serialNumber"),
+        organization=fields.get("organizationName"),
+    )
+
 
 class MqttBroker:
     def __init__(self, ctx: Optional[ServerContext] = None, **cfg_kwargs) -> None:
@@ -82,6 +112,11 @@ class MqttBroker:
 
             sslctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             sslctx.load_cert_chain(cfg.tls_cert, cfg.tls_key or None)
+            if cfg.tls_client_ca:
+                # mutual TLS (builder.rs tls_cross_certificate): require and
+                # verify client certs; metadata lands in ConnectInfo
+                sslctx.load_verify_locations(cfg.tls_client_ca)
+                sslctx.verify_mode = ssl.CERT_REQUIRED
         if cfg.ws_port is not None:
             self._ws_server = await asyncio.start_server(
                 self._on_ws_connection, cfg.host, cfg.ws_port
@@ -131,6 +166,14 @@ class MqttBroker:
             return
         ctx.handshaking += 1
         try:
+            peer = writer.get_extra_info("peername")
+            if ctx.cfg.proxy_protocol and writer.get_extra_info("ssl_object") is None:
+                # the PROXY header precedes the HTTP upgrade on the raw
+                # stream; parsed inside the handshaking window so slow-header
+                # floods stay visible to the overload gate
+                peer = await self._read_proxy(reader, writer, peer)
+                if peer is None:
+                    return
             ok = await websocket_accept(reader, writer)
         finally:
             ctx.handshaking -= 1
@@ -139,14 +182,32 @@ class MqttBroker:
             return
         ws_writer = WsWriter(writer)
         ws_reader = WsReader(reader, ws_writer)
-        await self._on_connection(ws_reader, ws_writer)
+        await self._on_connection(ws_reader, ws_writer, peer=peer)
 
-    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    async def _read_proxy(self, reader, writer, peer):
+        """Parse a PROXY v1/v2 header; → effective peer addr, or None after
+        closing a connection with a malformed/timed-out header."""
+        from rmqtt_tpu.broker.proxy_protocol import ProxyProtocolError, read_proxy_header
+
+        try:
+            src = await asyncio.wait_for(
+                read_proxy_header(reader), timeout=self.ctx.cfg.max_handshake_delay
+            )
+            return src if src is not None else peer
+        except (ProxyProtocolError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError, OSError):
+            self.ctx.metrics.inc("proxy_protocol.errors")
+            writer.close()
+            return None
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, peer=_UNSET
+    ):
         ctx = self.ctx
-        peer = writer.get_extra_info("peername")
         codec = MqttCodec(max_inbound_size=ctx.cfg.max_packet_size)
         ctx.metrics.inc("connections.accepted")
-        # overload protection: refuse before reading the CONNECT
+        # overload protection: refuse before reading ANY bytes — including a
+        # PROXY header, so slow-header floods cannot bypass the gate
         # (v5.rs:120-125 busy check)
         if ctx.is_busy():
             ctx.metrics.inc("handshake.refused_busy")
@@ -155,6 +216,12 @@ class MqttBroker:
         ctx.handshaking += 1
         ctx.handshake_rate.inc()
         try:
+            if peer is _UNSET:
+                peer = writer.get_extra_info("peername")
+                if ctx.cfg.proxy_protocol and writer.get_extra_info("ssl_object") is None:
+                    peer = await self._read_proxy(reader, writer, peer)
+                    if peer is None:
+                        return
             try:
                 got = await asyncio.wait_for(
                     self._read_connect(reader, codec), timeout=ctx.cfg.max_handshake_delay
@@ -223,6 +290,7 @@ class MqttBroker:
             properties=connect.properties,
             remote_addr=peer,
             will=connect.will,
+            cert_info=extract_cert_info(writer),
         )
         await ctx.hooks.fire(HookType.CLIENT_CONNECT, ci, None, None)
         # v5 enhanced authentication (spec §4.12, codec auth.rs): a CONNECT

@@ -62,6 +62,17 @@ class Message:
 
 
 @dataclass
+class CertInfo:
+    """TLS client-certificate metadata surfaced into ConnectInfo
+    (reference rmqtt-net/src/cert_extractor.rs + rmqtt-codec CertInfo)."""
+
+    common_name: Optional[str] = None
+    subject: Optional[str] = None
+    serial: Optional[str] = None
+    organization: Optional[str] = None
+
+
+@dataclass
 class ConnectInfo:
     """Who connected and how (reference types.rs ConnectInfo V3/V5)."""
 
@@ -74,6 +85,7 @@ class ConnectInfo:
     properties: Dict[int, object] = field(default_factory=dict)
     remote_addr: Optional[Tuple[str, int]] = None
     will: Optional[pk.Will] = None
+    cert_info: Optional[CertInfo] = None
 
 
 # --- v5 reason codes used by broker paths (MQTT-5.0 2.4) ---

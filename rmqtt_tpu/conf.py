@@ -109,6 +109,8 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "wss_port": int(listener["wss_port"]) if "wss_port" in listener else None,
         "tls_cert": listener.get("tls_cert", ""),
         "tls_key": listener.get("tls_key", ""),
+        "tls_client_ca": listener.get("tls_client_ca", ""),
+        "proxy_protocol": bool(listener.get("proxy_protocol", False)),
         "node_id": int(node.get("id", 1)),
         "router": node.get("router", "trie"),
         "fitter": fitter,

@@ -182,3 +182,168 @@ def test_wss_listener(certs):
         await b.stop()
 
     run_async(run)
+
+
+# ---------------------------------------------------------------- proxy proto
+
+
+def test_proxy_protocol_v1_and_v2():
+    """PROXY v1/v2 headers replace the socket peer with the advertised
+    source (builder.rs:152,466-474); malformed headers close the socket."""
+    from rmqtt_tpu.broker.proxy_protocol import encode_v1, encode_v2
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, proxy_protocol=True)))
+        await b.start()
+        try:
+            codec = MqttCodec()
+            for header, cid, want in [
+                (encode_v1("203.0.113.7", "10.0.0.1", 12345, 1883), "pp1",
+                 ("203.0.113.7", 12345)),
+                (encode_v2("198.51.100.9", "10.0.0.1", 23456, 1883), "pp2",
+                 ("198.51.100.9", 23456)),
+            ]:
+                reader, writer = await asyncio.open_connection("127.0.0.1", b.port)
+                writer.write(header + codec.encode(pk.Connect(client_id=cid)))
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(1024), 5)
+                (ack,) = MqttCodec().feed(data)
+                assert isinstance(ack, pk.Connack) and ack.reason_code == 0
+                s = b.ctx.registry.get(cid)
+                assert tuple(s.connect_info.remote_addr)[:2] == want
+                writer.close()
+            # v1 UNKNOWN falls back to the socket peer
+            reader, writer = await asyncio.open_connection("127.0.0.1", b.port)
+            writer.write(b"PROXY UNKNOWN\r\n" + codec.encode(pk.Connect(client_id="ppu")))
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(1024), 5)
+            (ack,) = MqttCodec().feed(data)
+            assert ack.reason_code == 0
+            assert b.ctx.registry.get("ppu").connect_info.remote_addr[0] == "127.0.0.1"
+            writer.close()
+            # garbage instead of a header: closed without CONNACK
+            reader, writer = await asyncio.open_connection("127.0.0.1", b.port)
+            writer.write(b"\x10\x0c" + b"junk" * 3)
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(1024), 5)
+            assert data == b""
+            assert b.ctx.metrics.get("proxy_protocol.errors") >= 1
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+def test_proxy_protocol_on_ws_listener():
+    from rmqtt_tpu.broker.proxy_protocol import encode_v2
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, ws_port=0, proxy_protocol=True)))
+        await b.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", b.ws_port)
+            # PROXY header precedes the HTTP upgrade
+            writer.write(encode_v2("192.0.2.33", "10.0.0.1", 4242, 8080))
+            key = base64.b64encode(os.urandom(16)).decode()
+            writer.write(
+                (
+                    f"GET /mqtt HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+                    "Connection: Upgrade\r\n"
+                    f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+                ).encode()
+            )
+            await writer.drain()
+            resp = await reader.readuntil(b"\r\n\r\n")
+            assert b"101" in resp.split(b"\r\n")[0]
+            codec = MqttCodec()
+            writer.write(mask_client_frame(OP_BIN, codec.encode(pk.Connect(client_id="ppws"))))
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            s = b.ctx.registry.get("ppws")
+            assert s is not None
+            assert tuple(s.connect_info.remote_addr)[:2] == ("192.0.2.33", 4242)
+            writer.close()
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+# ------------------------------------------------------------------- mTLS
+
+
+@pytest.fixture(scope="module")
+def client_ca(tmp_path_factory):
+    """CA + a CA-signed client certificate (CN=device-42, O=AcmeOrg)."""
+    d = tmp_path_factory.mktemp("clientca")
+    ca_key, ca_pem = d / "ca.key", d / "ca.pem"
+    c_key, c_csr, c_pem = d / "client.key", d / "client.csr", d / "client.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(ca_key), "-out", str(ca_pem), "-days", "1",
+         "-subj", "/CN=TestCA/O=rmqtt-tpu"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(c_key), "-out", str(c_csr),
+         "-subj", "/CN=device-42/O=AcmeOrg"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["openssl", "x509", "-req", "-in", str(c_csr), "-CA", str(ca_pem),
+         "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(c_pem), "-days", "1"],
+        check=True, capture_output=True,
+    )
+    return str(ca_pem), str(c_pem), str(c_key)
+
+
+def test_tls_client_cert_extraction(certs, client_ca):
+    """Mutual TLS: the verified client cert's CN/O/serial surface in
+    ConnectInfo.cert_info (cert_extractor.rs:1-71)."""
+    cert, key = certs
+    ca_pem, client_pem, client_key = client_ca
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, tls_port=0, tls_cert=cert, tls_key=key, tls_client_ca=ca_pem,
+        )))
+        await b.start()
+        try:
+            cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cctx.check_hostname = False
+            cctx.verify_mode = ssl.CERT_NONE
+            cctx.load_cert_chain(client_pem, client_key)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", b.tls_port, ssl=cctx
+            )
+            codec = MqttCodec()
+            writer.write(codec.encode(pk.Connect(client_id="mtls-dev")))
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(1024), 5)
+            (ack,) = MqttCodec().feed(data)
+            assert ack.reason_code == 0
+            info = b.ctx.registry.get("mtls-dev").connect_info.cert_info
+            assert info is not None
+            assert info.common_name == "device-42"
+            assert info.organization == "AcmeOrg"
+            assert info.serial
+            assert "commonName=device-42" in info.subject
+            writer.close()
+
+            # a client WITHOUT a certificate is rejected in the TLS handshake
+            cctx2 = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cctx2.check_hostname = False
+            cctx2.verify_mode = ssl.CERT_NONE
+            with pytest.raises((ssl.SSLError, ConnectionError)):
+                r2, w2 = await asyncio.open_connection(
+                    "127.0.0.1", b.tls_port, ssl=cctx2
+                )
+                w2.write(MqttCodec().encode(pk.Connect(client_id="nocert")))
+                await w2.drain()
+                assert await asyncio.wait_for(r2.read(1024), 5) == b""
+                raise ConnectionError("server closed without TLS error")
+        finally:
+            await b.stop()
+
+    run_async(run)
