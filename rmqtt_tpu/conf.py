@@ -181,6 +181,8 @@ PLUGIN_REGISTRY: Dict[str, str] = {
     "rmqtt-bridge-egress-mqtt": "rmqtt_tpu.plugins.bridge_mqtt:BridgeEgressMqttPlugin",
     "rmqtt-bridge-ingress-nats": "rmqtt_tpu.plugins.bridge_nats:BridgeIngressNatsPlugin",
     "rmqtt-bridge-egress-nats": "rmqtt_tpu.plugins.bridge_nats:BridgeEgressNatsPlugin",
+    "rmqtt-bridge-ingress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeIngressKafkaPlugin",
+    "rmqtt-bridge-egress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeEgressKafkaPlugin",
 }
 
 

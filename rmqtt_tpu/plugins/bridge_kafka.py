@@ -1,0 +1,243 @@
+"""Kafka bridge plugins (ingress + egress).
+
+Mirror `rmqtt-plugins/rmqtt-bridge-ingress-kafka` / `-egress-kafka`
+capability on the dependency-free wire client (`bridge/kafka_client.py`):
+
+- ingress: explicit-partition consumers (the reference's
+  ``start_partition``/``stop_partition`` manual assignment,
+  `ingress-kafka/src/config.rs:80-101`) fetch RecordBatches and republish
+  into the broker; record headers become v5 user properties; the record key
+  surfaces as the ``_message_key`` property (config.rs:25 MESSAGE_KEY).
+- egress: matching local publishes are produced to a remote topic; the
+  ``_message_key`` user property (when present) becomes the record key, the
+  MQTT topic rides a ``mqtt_topic`` header; partition -1 round-robins over
+  the topic's partitions (config.rs:22 PARTITION_UNASSIGNED).
+
+Config::
+
+    [plugins.rmqtt-bridge-egress-kafka]
+    servers = "127.0.0.1:9092"
+    forwards = [
+      { filter = "iot/#", remote_topic = "mqtt-events", partition = -1 },
+    ]
+
+    [plugins.rmqtt-bridge-ingress-kafka]
+    servers = "127.0.0.1:9092"
+    subscribes = [
+      { topic = "commands", local_topic = "kafka/${topic}",
+        start_partition = -1, stop_partition = -1, offset = "latest",
+        qos = 0, retain = false },
+    ]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional
+
+from rmqtt_tpu.bridge.kafka_client import EARLIEST, LATEST, KafkaClient, KafkaError
+from rmqtt_tpu.broker.codec import props as P
+from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.types import Message
+from rmqtt_tpu.core.topic import match_filter
+from rmqtt_tpu.plugins import Plugin
+from rmqtt_tpu.router.base import Id
+
+log = logging.getLogger("rmqtt_tpu.bridge.kafka")
+
+MESSAGE_KEY = "_message_key"  # reference ingress-kafka/src/config.rs:25
+
+
+class BridgeIngressKafkaPlugin(Plugin):
+    name = "rmqtt-bridge-ingress-kafka"
+    descr = "Kafka topics → local MQTT topics"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        self.servers = self.config.get("servers", "127.0.0.1:9092")
+        self.subscribes: List[dict] = self.config.get("subscribes", [])
+        self.reconnect_delay = float(self.config.get("reconnect_delay", 3.0))
+        self._client: Optional[KafkaClient] = None
+        self._tasks: List[asyncio.Task] = []
+        self.forwarded = 0
+
+    async def start(self) -> None:
+        self._client = KafkaClient(self.servers, client_id=f"rmqtt-in-{self.ctx.node_id}")
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._consume_entry(entry)) for entry in self.subscribes
+        ]
+
+    async def stop(self) -> bool:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+        return True
+
+    def attrs(self):
+        return {"servers": self.servers, "entries": len(self.subscribes),
+                "forwarded": self.forwarded}
+
+    async def _consume_entry(self, entry: dict) -> None:
+        topic = entry["topic"]
+        start_p = int(entry.get("start_partition", -1))
+        stop_p = int(entry.get("stop_partition", -1))
+        where = EARLIEST if entry.get("offset", "latest") in ("beginning", "earliest") else LATEST
+        while True:  # partition discovery, with retry
+            try:
+                parts = await self._client.partitions(topic)
+                if parts:
+                    break
+                raise KafkaError(3, f"no partitions for {topic}")
+            except asyncio.CancelledError:
+                raise
+            except (KafkaError, ConnectionError, OSError) as e:
+                log.warning("kafka ingress %s: %s; retrying", topic, e)
+                await asyncio.sleep(self.reconnect_delay)
+        # manual assignment window (config.rs start/stop_partition;
+        # -1 = unbounded on that side). Each partition consumer is fully
+        # self-healing (never raises), so one transient failure can neither
+        # kill nor duplicate its siblings.
+        assigned = [
+            p for p in parts
+            if (start_p < 0 or p >= start_p) and (stop_p < 0 or p <= stop_p)
+        ]
+        await asyncio.gather(
+            *(self._consume_partition(entry, topic, p, where) for p in assigned)
+        )
+
+    async def _consume_partition(self, entry: dict, topic: str, partition: int,
+                                 where: int) -> None:
+        while True:  # initial offset resolution, with retry
+            try:
+                offset = await self._client.list_offset(topic, partition, at=where)
+                break
+            except asyncio.CancelledError:
+                raise
+            except (KafkaError, ConnectionError, OSError) as e:
+                log.warning("kafka list_offset %s[%s]: %s; retrying", topic, partition, e)
+                await asyncio.sleep(self.reconnect_delay)
+        qos = int(entry.get("qos", 0))
+        retain = bool(entry.get("retain", False))
+        local_pattern = entry.get("local_topic", "$kafka/${topic}")
+        from_id = Id(self.ctx.node_id, f"kafka-in-{self.ctx.node_id}")
+        while True:
+            try:
+                records, _hw = await self._client.fetch(topic, partition, offset)
+            except asyncio.CancelledError:
+                raise
+            except (KafkaError, ConnectionError, OSError) as e:
+                log.warning("kafka fetch %s[%s]: %s; retrying", topic, partition, e)
+                await asyncio.sleep(self.reconnect_delay)
+                continue
+            for off, _ts, key, value, headers in records:
+                offset = off + 1
+                local = (
+                    local_pattern
+                    .replace("${topic}", topic)
+                    .replace("${partition}", str(partition))
+                )
+                properties = {P.USER_PROPERTY: [(hk, hv.decode("utf-8", "replace"))
+                                                for hk, hv in headers]}
+                if key:
+                    properties[P.USER_PROPERTY].append(
+                        (MESSAGE_KEY, key.decode("utf-8", "replace"))
+                    )
+                msg = Message(
+                    topic=local, payload=value or b"", qos=qos, retain=retain,
+                    properties=properties, from_id=from_id,
+                )
+                if retain:
+                    self.ctx.retain.set(local, msg)
+                await self.ctx.registry.forwards(msg)
+                self.forwarded += 1
+
+
+class BridgeEgressKafkaPlugin(Plugin):
+    name = "rmqtt-bridge-egress-kafka"
+    descr = "local MQTT topics → Kafka topics"
+
+    def __init__(self, ctx, config=None) -> None:
+        super().__init__(ctx, config)
+        self.servers = self.config.get("servers", "127.0.0.1:9092")
+        self.forwards: List[dict] = self.config.get("forwards", [])
+        self.max_queue = int(self.config.get("max_queue", 10_000))
+        self._client: Optional[KafkaClient] = None
+        self._q: Optional[asyncio.Queue] = None
+        self._pump: Optional[asyncio.Task] = None
+        self._unhooks = []
+        self._rr = 0
+
+    async def start(self) -> None:
+        self._client = KafkaClient(self.servers, client_id=f"rmqtt-out-{self.ctx.node_id}")
+        self._q = asyncio.Queue(maxsize=self.max_queue)
+        self._pump = asyncio.get_running_loop().create_task(self._drain())
+
+        async def on_publish(_ht, args, prev):
+            msg = prev if prev is not None else args[1]
+            # every matching entry forwards independently (each has its own
+            # remote topic/partition)
+            for entry in self.forwards:
+                if match_filter(entry.get("filter", "#"), msg.topic):
+                    try:
+                        self._q.put_nowait((entry, msg))
+                    except asyncio.QueueFull:
+                        self.ctx.metrics.inc("bridge.kafka.dropped")
+            return None
+
+        self._unhooks = [
+            self.ctx.hooks.register(HookType.MESSAGE_PUBLISH, on_publish, priority=-100)
+        ]
+
+    async def _drain(self) -> None:
+        while True:
+            entry, msg = await self._q.get()
+            topic = entry.get("remote_topic", msg.topic.replace("/", "."))
+            partition = int(entry.get("partition", -1))
+            key = None
+            for uk, uv in msg.properties.get(P.USER_PROPERTY, []) or []:
+                if uk == MESSAGE_KEY:
+                    key = uv.encode()
+            headers = [("mqtt_topic", msg.topic.encode())]
+            try:
+                if partition < 0:  # PARTITION_UNASSIGNED: round-robin
+                    parts = await self._client.partitions(topic)
+                    if not parts:
+                        raise KafkaError(3, f"no partitions for {topic}")
+                    self._rr += 1
+                    partition = parts[self._rr % len(parts)]
+                await self._client.produce(
+                    topic, msg.payload, key=key, partition=partition,
+                    headers=headers, timestamp_ms=int(time.time() * 1000),
+                )
+                self.ctx.metrics.inc("bridge.kafka.forwarded")
+            except asyncio.CancelledError:
+                raise
+            except (KafkaError, ConnectionError, OSError) as e:
+                log.warning("kafka egress %s: %s", topic, e)
+                self.ctx.metrics.inc("bridge.kafka.errors")
+
+    async def stop(self) -> bool:
+        for un in self._unhooks:
+            un()
+        self._unhooks = []
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+        return True
+
+    def attrs(self):
+        return {"servers": self.servers, "entries": len(self.forwards)}
