@@ -59,7 +59,9 @@ class BrokerConfig:
     cluster: bool = False  # use a cluster-aware session registry
     cluster_mode: str = "broadcast"  # "broadcast" | "raft"
     # overload protection (reference busy detection, node.rs:212-239 +
-    # handshake executor limits, executor.rs:66-137)
+    # handshake executor limits, executor.rs:66-137). NOTE reference
+    # semantics: new connections are REFUSED once a listener's active
+    # handshakes exceed 35% of max_handshaking (executor.rs:100 busy rule)
     max_handshaking: int = 2000
     max_handshake_rate: float = 0.0  # 0 = unlimited, else handshakes/sec
     busy_loadavg: float = 0.0  # 0 = ignore; else refuse above load1/ncpu
@@ -123,15 +125,26 @@ class ServerContext:
         from rmqtt_tpu.utils.counter import RateCounter
 
         self.plugins = PluginManager(self)
-        self.handshaking = 0
         self.handshake_rate = RateCounter(window=5.0)
+        from rmqtt_tpu.broker.executor import HandshakeExecutor
+
+        self.hs_executor = HandshakeExecutor(
+            workers=self.cfg.max_handshaking, queue_max=self.cfg.max_connections
+        )
+
+    @property
+    def handshaking(self) -> int:
+        """In-flight handshakes across all listeners (executor active count)."""
+        return self.hs_executor.active_count()
 
     def is_busy(self) -> bool:
         """Overload check before accepting a handshake (context.rs:400-406,
-        node.rs:212-239): too many concurrent handshakes, handshake-rate cap,
-        or 1-minute loadavg per cpu above threshold."""
+        node.rs:212-239): a busy handshake executor (ANY port above 35% of
+        its worker bound — executor.rs:100-106,137 aggregates across ports
+        the same way), handshake-rate cap, or 1-minute loadavg per cpu
+        above threshold. Admission itself is the executor's job."""
         cfg = self.cfg
-        if self.handshaking >= cfg.max_handshaking:
+        if self.hs_executor.is_busy():
             return True
         if cfg.max_handshake_rate and self.handshake_rate.rate() > cfg.max_handshake_rate:
             return True

@@ -164,19 +164,28 @@ class MqttBroker:
             ctx.metrics.inc("handshake.refused_busy")
             writer.close()
             return
-        ctx.handshaking += 1
+        # the upgrade occupies an executor slot too: slow-header WS floods
+        # must hit the same 35% busy rule as raw MQTT handshakes
+        from rmqtt_tpu.broker.executor import ExecutorFull
+
+        sockname = writer.get_extra_info("sockname")
+        entry = ctx.hs_executor.entry(sockname[1] if sockname else 0)
+        try:
+            await entry.acquire()
+        except ExecutorFull:
+            ctx.metrics.inc("handshake.refused_full")
+            writer.close()
+            return
         try:
             peer = writer.get_extra_info("peername")
             if ctx.cfg.proxy_protocol and writer.get_extra_info("ssl_object") is None:
-                # the PROXY header precedes the HTTP upgrade on the raw
-                # stream; parsed inside the handshaking window so slow-header
-                # floods stay visible to the overload gate
+                # the PROXY header precedes the HTTP upgrade on the raw stream
                 peer = await self._read_proxy(reader, writer, peer)
                 if peer is None:
                     return
             ok = await websocket_accept(reader, writer)
         finally:
-            ctx.handshaking -= 1
+            entry.release()
         if not ok:
             writer.close()
             return
@@ -213,7 +222,18 @@ class MqttBroker:
             ctx.metrics.inc("handshake.refused_busy")
             writer.close()
             return
-        ctx.handshaking += 1
+        # per-listener bounded executor (executor.rs:66-137): handshakes
+        # beyond the worker bound queue up to queue_max, then refuse
+        from rmqtt_tpu.broker.executor import ExecutorFull
+
+        sockname = writer.get_extra_info("sockname")
+        entry = ctx.hs_executor.entry(sockname[1] if sockname else 0)
+        try:
+            await entry.acquire()
+        except ExecutorFull:
+            ctx.metrics.inc("handshake.refused_full")
+            writer.close()
+            return
         ctx.handshake_rate.inc()
         try:
             if peer is _UNSET:
@@ -236,7 +256,7 @@ class MqttBroker:
             connect, early = got
             state = await self._handshake(connect, reader, writer, codec, peer, early)
         finally:
-            ctx.handshaking -= 1
+            entry.release()
         if state is not None:
             state.early_packets = early
             try:

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Connection-scale soak: N concurrent MQTT connections against a real
+broker process; measures handshake rate, steady-state RSS, and liveness
+under full load (BASELINE.md context: the reference reports 1M connections
+at ~5.5-7K handshakes/s on 4 cores; this box is 1 core and fd-limited, so
+the soak validates the per-connection cost curve, not the absolute record).
+
+Usage: python scripts/soak_bench.py [--conns 10000] [--broker-port 18900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk  # noqa: E402
+
+
+def rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+async def open_one(port: int, cid: str):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    codec = MqttCodec()
+    writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+    await writer.drain()
+    while True:
+        data = await reader.read(64)
+        if not data:
+            raise ConnectionError("closed during handshake")
+        for p in codec.feed(data):
+            if isinstance(p, pk.Connack):
+                assert p.reason_code == 0, p.reason_code
+                return reader, writer, codec
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conns", type=int, default=10_000)
+    ap.add_argument("--broker-port", type=int, default=18900)
+    ap.add_argument("--wave", type=int, default=500, help="concurrent dials per wave")
+    args = ap.parse_args()
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(args.broker_port)],
+        cwd=str(Path(__file__).resolve().parent.parent),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(100):
+            try:
+                with socket.create_connection(("127.0.0.1", args.broker_port), timeout=0.3):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        base_rss = rss_mb(proc.pid)
+        print(f"broker pid {proc.pid}, baseline RSS {base_rss:.1f} MB")
+
+        conns = []
+        t0 = time.perf_counter()
+        for start in range(0, args.conns, args.wave):
+            n = min(args.wave, args.conns - start)
+            results = await asyncio.gather(
+                *(open_one(args.broker_port, f"soak-{start + i}") for i in range(n)),
+                return_exceptions=True,
+            )
+            ok = [r for r in results if not isinstance(r, Exception)]
+            conns.extend(ok)
+            if len(ok) < n:
+                errs = [r for r in results if isinstance(r, Exception)]
+                print(f"  wave at {start}: {n - len(ok)} failures (first: {errs[0]!r})")
+        dt = time.perf_counter() - t0
+        established = len(conns)
+        print(f"established {established} connections in {dt:.1f}s "
+              f"({established / dt:.0f} handshakes/s)")
+        full_rss = rss_mb(proc.pid)
+        print(f"RSS at {established} conns: {full_rss:.1f} MB "
+              f"({(full_rss - base_rss) * 1024 / max(1, established):.1f} KB/conn)")
+
+        # liveness: a fresh pub/sub pair routes while all conns are open
+        sr, sw, sc = await open_one(args.broker_port, "soak-sub")
+        pid_counter = [0]
+
+        def next_pid():
+            pid_counter[0] += 1
+            return pid_counter[0]
+
+        sw.write(sc.encode(pk.Subscribe(next_pid(), [("soak/t", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        await sr.read(64)  # suback
+        pr, pw, pcodec = await open_one(args.broker_port, "soak-pub")
+        t0 = time.perf_counter()
+        pw.write(pcodec.encode(pk.Publish(topic="soak/t", payload=b"alive")))
+        await pw.drain()
+        while True:
+            data = await sr.read(1024)
+            assert data, "subscriber closed"
+            if any(isinstance(p, pk.Publish) for p in sc.feed(data)):
+                break
+        print(f"pub->sub delivery at full load: {(time.perf_counter() - t0) * 1000:.1f} ms")
+
+        # ping a sample of the idle connections
+        sample = conns[:: max(1, len(conns) // 50)]
+        t0 = time.perf_counter()
+        for r, w, c in sample:
+            w.write(c.encode(pk.Pingreq()))
+            await w.drain()
+            while not any(isinstance(p, pk.Pingresp) for p in c.feed(await r.read(64))):
+                pass
+        print(f"{len(sample)} sampled pings: "
+              f"{(time.perf_counter() - t0) / len(sample) * 1000:.2f} ms avg rtt")
+        for r, w, c in conns:
+            w.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

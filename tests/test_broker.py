@@ -434,3 +434,49 @@ async def test_pipelined_connect_subscribe_publish(broker):
     assert got["suback"].packet_id == 1
     assert got["publish"].topic == "pipe/t" and got["publish"].payload == b"early"
     writer.close()
+
+
+def test_handshake_executor_gate():
+    """Per-listener bounded handshake executor (executor.rs:66-137): once
+    active handshakes exceed 35% of the worker bound the port reports busy
+    and further connections are refused before any bytes are read."""
+    from rmqtt_tpu.broker.executor import ExecutorFull, ListenerExecutor
+
+    async def run():
+        # unit semantics: workers=2 -> busy_limit=1; queue bound enforced
+        ex = ListenerExecutor(workers=2, queue_max=1)
+        await ex.acquire()
+        assert ex.is_busy  # 1 active >= 35% of 2
+        await ex.acquire()  # second worker slot still grantable
+        waiter = asyncio.create_task(ex.acquire())  # queues (waiting=1)
+        await asyncio.sleep(0.01)
+        try:
+            await ex.acquire()  # queue full
+            raise AssertionError("expected ExecutorFull")
+        except ExecutorFull:
+            pass
+        ex.release()
+        await asyncio.wait_for(waiter, 1.0)
+        ex.release(); ex.release()
+
+        # end-to-end: a stalled handshake saturates the tiny executor and
+        # the next connection is closed without a CONNACK
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, max_handshaking=2)))
+        await b.start()
+        try:
+            stall_r, stall_w = await asyncio.open_connection("127.0.0.1", b.port)
+            await asyncio.sleep(0.1)  # let it occupy a handshake slot
+            r2, w2 = await asyncio.open_connection("127.0.0.1", b.port)
+            data = await asyncio.wait_for(r2.read(64), 5)
+            assert data == b"", "expected refusal while executor busy"
+            assert b.ctx.metrics.get("handshake.refused_busy") >= 1
+            stall_w.close()
+            await asyncio.sleep(0.1)
+            # slot released: connects succeed again
+            c = await connect(b, "after-stall")
+            assert c.connack.reason_code == 0
+            await c.disconnect_clean()
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
