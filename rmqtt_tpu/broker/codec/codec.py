@@ -44,6 +44,30 @@ from rmqtt_tpu.broker.codec.props import decode_properties, encode_properties
 
 _PROTO_NAMES = {b"MQIsdp": pk.V31, b"MQTT": None}  # None → level byte decides
 
+# native frame scanner (runtime/codec.cc): None = not probed, False = absent
+_native = None
+
+
+def _native_lib():
+    global _native
+    if _native is None:
+        try:
+            from rmqtt_tpu import runtime as _rt
+
+            _native = _rt.load() or False
+        except Exception:
+            _native = False
+    return _native or None
+
+
+_SCAN_ERRORS = {
+    1: "malformed remaining length",
+    2: "packet too large",
+    3: "invalid QoS 3",
+    4: "malformed PUBLISH",
+    5: "malformed properties length",
+}
+
 
 class MqttCodec:
     """Incremental decoder + encoder for one connection."""
@@ -63,6 +87,18 @@ class MqttCodec:
             raise self.pending_error
         self._buf += data
         out: List[Packet] = []
+        lib = _native_lib()
+        if lib is not None and self._have_complete_frame():
+            # C++ fast path: scan all complete frames at once, PUBLISH
+            # pre-parsed (runtime/codec.cc). Stops at CONNECT/incomplete;
+            # the Python loop below handles whatever remains. The cheap
+            # completeness peek keeps large fragmented packets O(1) per
+            # chunk (no buffer snapshot until a frame can actually decode).
+            self._feed_native(lib, out)
+            if self.pending_error is not None:
+                if out:
+                    return out
+                raise self.pending_error
         while True:
             try:
                 frame = self._next_frame()
@@ -81,6 +117,73 @@ class MqttCodec:
                 if out:
                     return out
                 raise
+
+    def _have_complete_frame(self) -> bool:
+        """Fixed-header peek: is at least one full frame buffered? (Also
+        true for frames the scan should reject — it surfaces the error.)"""
+        buf = self._buf
+        if len(buf) < 2:
+            return False
+        mult, length, i = 1, 0, 1
+        while True:
+            if i >= len(buf):
+                return False
+            b = buf[i]
+            length += (b & 0x7F) * mult
+            i += 1
+            if not b & 0x80:
+                break
+            mult *= 128
+            if mult > 128**3:
+                return True  # malformed: let the scan report it
+        return length > self.max_inbound_size or len(buf) >= i + length
+
+    def _feed_native(self, lib, out: List[Packet]) -> None:
+        from rmqtt_tpu import runtime as rt
+
+        v5 = self.version == pk.V5
+        while True:
+            buf = bytes(self._buf)
+            rows, consumed, err, hit_cap = rt.codec_scan(lib, buf, v5, self.max_inbound_size)
+            if consumed:
+                del self._buf[:consumed]
+            for m in rows:
+                first = m[0]
+                try:
+                    if first >> 4 == pk.TYPE_PUBLISH:
+                        out.append(self._build_publish(buf, m, v5))
+                    else:
+                        out.append(self._decode(first, buf[m[1] : m[1] + m[2]]))
+                except ProtocolError as e:
+                    self.pending_error = e
+                    return
+            if err:
+                self.pending_error = ProtocolError(
+                    _SCAN_ERRORS.get(err, f"scan error {err}")
+                )
+                return
+            if not hit_cap:
+                return
+
+    def _build_publish(self, buf: bytes, m, v5: bool) -> Publish:
+        first = m[0]
+        qos = (first >> 1) & 0x3
+        try:
+            topic = buf[m[3] : m[3] + m[4]].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise ProtocolError(f"invalid utf8: {e}") from e
+        props = {}
+        if v5 and m[7] > 1:  # a single byte is the zero-length varint
+            props = decode_properties(Reader(buf[m[6] : m[6] + m[7]]))
+        return Publish(
+            topic=topic,
+            payload=buf[m[8] : m[8] + m[9]],
+            qos=qos,
+            retain=bool(first & 0x1),
+            dup=bool(first & 0x8),
+            packet_id=m[5] if m[5] >= 0 else None,
+            properties=props,
+        )
 
     def _next_frame(self) -> Optional[Tuple[int, bytes]]:
         buf = self._buf

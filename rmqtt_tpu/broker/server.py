@@ -19,6 +19,7 @@ from typing import Optional
 
 from rmqtt_tpu.broker.codec import MqttCodec, packets as pk, props as P
 from rmqtt_tpu.broker.codec.primitives import ProtocolViolation
+from rmqtt_tpu.broker.executor import ExecutorFull
 from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
 from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.session import SessionState
@@ -166,15 +167,8 @@ class MqttBroker:
             return
         # the upgrade occupies an executor slot too: slow-header WS floods
         # must hit the same 35% busy rule as raw MQTT handshakes
-        from rmqtt_tpu.broker.executor import ExecutorFull
-
-        sockname = writer.get_extra_info("sockname")
-        entry = ctx.hs_executor.entry(sockname[1] if sockname else 0)
-        try:
-            await entry.acquire()
-        except ExecutorFull:
-            ctx.metrics.inc("handshake.refused_full")
-            writer.close()
+        entry = await self._acquire_handshake_slot(writer)
+        if entry is None:
             return
         try:
             peer = writer.get_extra_info("peername")
@@ -192,6 +186,19 @@ class MqttBroker:
         ws_writer = WsWriter(writer)
         ws_reader = WsReader(reader, ws_writer)
         await self._on_connection(ws_reader, ws_writer, peer=peer)
+
+    async def _acquire_handshake_slot(self, writer):
+        """Take a slot in the listener's bounded handshake executor; → the
+        entry (caller must release()), or None after refusing + closing."""
+        sockname = writer.get_extra_info("sockname")
+        entry = self.ctx.hs_executor.entry(sockname[1] if sockname else 0)
+        try:
+            await entry.acquire()
+        except ExecutorFull:
+            self.ctx.metrics.inc("handshake.refused_full")
+            writer.close()
+            return None
+        return entry
 
     async def _read_proxy(self, reader, writer, peer):
         """Parse a PROXY v1/v2 header; → effective peer addr, or None after
@@ -224,15 +231,8 @@ class MqttBroker:
             return
         # per-listener bounded executor (executor.rs:66-137): handshakes
         # beyond the worker bound queue up to queue_max, then refuse
-        from rmqtt_tpu.broker.executor import ExecutorFull
-
-        sockname = writer.get_extra_info("sockname")
-        entry = ctx.hs_executor.entry(sockname[1] if sockname else 0)
-        try:
-            await entry.acquire()
-        except ExecutorFull:
-            ctx.metrics.inc("handshake.refused_full")
-            writer.close()
+        entry = await self._acquire_handshake_slot(writer)
+        if entry is None:
             return
         ctx.handshake_rate.inc()
         try:

@@ -47,7 +47,7 @@ def load() -> Optional[ctypes.CDLL]:
         return _lib
     if _build_failed:
         return None
-    srcs = [_RUNTIME_DIR / "topics.cc", _RUNTIME_DIR / "encode.cc"]
+    srcs = [_RUNTIME_DIR / "topics.cc", _RUNTIME_DIR / "encode.cc", _RUNTIME_DIR / "codec.cc"]
     if not _LIB_PATH.exists() or any(
         s.exists() and s.stat().st_mtime > _LIB_PATH.stat().st_mtime for s in srcs
     ):
@@ -91,8 +91,43 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.rt_enc_encode.restype = ctypes.c_int64
+    lib.rt_codec_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.rt_codec_scan.restype = ctypes.c_int64
+    lib.rt_topic_validate.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
+    lib.rt_topic_validate.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+CODEC_STRIDE = 10  # int64 slots per frame record (runtime/codec.cc)
+_SCAN_CAP = 8192  # frames per scan call; feed loops on over-full buffers
+
+
+def codec_scan(lib, buf: bytes, is_v5: bool, max_size: int):
+    """→ (rows list [n][stride], consumed, err, hit_cap)."""
+    cap = min(len(buf) // 2 + 1, _SCAN_CAP)
+    meta = np.empty((cap, CODEC_STRIDE), dtype=np.int64)
+    consumed = ctypes.c_int64(0)
+    err = ctypes.c_int32(0)
+    n = lib.rt_codec_scan(
+        buf, len(buf), 1 if is_v5 else 0, max_size,
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+        ctypes.byref(consumed), ctypes.byref(err),
+    )
+    return meta[:n].tolist(), consumed.value, err.value, n == cap
+
+
+def topic_validate(topic: str, is_filter: bool) -> Optional[bool]:
+    """Native topic/filter validation; None if the runtime is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = topic.encode()
+    return bool(lib.rt_topic_validate(raw, len(raw), 1 if is_filter else 0))
 
 
 def available() -> bool:

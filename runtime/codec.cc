@@ -1,0 +1,193 @@
+// C++ MQTT frame scanner + PUBLISH pre-parse + topic validation — the host
+// data-plane fast path for the Python codec.
+//
+// Semantics mirror rmqtt_tpu/broker/codec/codec.py (_next_frame + the
+// PUBLISH arm of _decode), which itself mirrors the reference MqttCodec
+// (/root/reference/rmqtt-codec/src/lib.rs:46-134) — re-implemented
+// independently in C++. One call scans a whole buffered byte stream into
+// frame records; PUBLISH frames (the broker's hot type) additionally carry
+// pre-parsed topic/packet-id/properties/payload spans so Python builds the
+// packet object without touching bytes. CONNECT stops the scan (it switches
+// the negotiated version mid-stream; Python handles it and re-enters).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kStride = 10;  // int64 slots per frame record (see rt_codec_scan)
+
+constexpr int32_t ERR_NONE = 0;
+constexpr int32_t ERR_BAD_LENGTH = 1;   // malformed remaining length
+constexpr int32_t ERR_TOO_LARGE = 2;    // > max_inbound_size
+constexpr int32_t ERR_BAD_QOS = 3;      // PUBLISH QoS 3
+constexpr int32_t ERR_TRUNCATED = 4;    // field runs past the body
+constexpr int32_t ERR_BAD_PROPS = 5;    // malformed property length varint
+
+}  // namespace
+
+extern "C" {
+
+// Scan complete frames from buf[0:len].
+//
+// meta layout per frame (int64 x 10):
+//   0: first byte   1: body_off   2: body_len
+//   for PUBLISH only (else zeros):
+//   3: topic_off    4: topic_len  5: packet_id (-1 = none)
+//   6: props_off (-1 for non-v5; offset of the props length varint)
+//   7: props_len (varint + content)
+//   8: payload_off  9: payload_len
+//
+// Returns the number of complete frames recorded; *consumed = bytes covered
+// by them; *err != 0 when the NEXT frame is malformed (caller surfaces the
+// protocol error after processing the good frames — codec.py semantics).
+// Scanning also stops (no error) on: incomplete frame, CONNECT, cap reached.
+int64_t rt_codec_scan(const uint8_t* buf, int64_t len, int32_t is_v5,
+                      int64_t max_size, int64_t* meta, int64_t cap,
+                      int64_t* consumed, int32_t* err) {
+  int64_t n = 0;
+  int64_t pos = 0;
+  *err = ERR_NONE;
+  while (n < cap && len - pos >= 2) {
+    const uint8_t first = buf[pos];
+    // fixed header varint remaining length
+    int64_t mult = 1, blen = 0, i = pos + 1;
+    bool complete = false;
+    while (i < len) {
+      const uint8_t b = buf[i];
+      blen += static_cast<int64_t>(b & 0x7F) * mult;
+      ++i;
+      if (!(b & 0x80)) {
+        complete = true;
+        break;
+      }
+      mult *= 128;
+      if (mult > 128LL * 128 * 128) {
+        *err = ERR_BAD_LENGTH;
+        *consumed = pos;
+        return n;
+      }
+    }
+    if (!complete) break;  // varint incomplete
+    if (blen > max_size) {
+      *err = ERR_TOO_LARGE;
+      *consumed = pos;
+      return n;
+    }
+    if (len - i < blen) break;  // body incomplete
+    const int type = first >> 4;
+    if (type == 1) break;  // CONNECT: version switch — Python takes over
+    int64_t* m = meta + n * kStride;
+    m[0] = first;
+    m[1] = i;
+    m[2] = blen;
+    m[3] = m[4] = m[6] = m[7] = m[8] = m[9] = 0;
+    m[5] = -1;
+    if (type == 3) {  // PUBLISH pre-parse
+      const int qos = (first >> 1) & 0x3;
+      if (qos == 3) {
+        *err = ERR_BAD_QOS;
+        *consumed = pos;
+        return n;
+      }
+      int64_t p = i;
+      const int64_t end = i + blen;
+      if (end - p < 2) {
+        *err = ERR_TRUNCATED;
+        *consumed = pos;
+        return n;
+      }
+      const int64_t tlen = (static_cast<int64_t>(buf[p]) << 8) | buf[p + 1];
+      p += 2;
+      if (end - p < tlen) {
+        *err = ERR_TRUNCATED;
+        *consumed = pos;
+        return n;
+      }
+      m[3] = p;
+      m[4] = tlen;
+      p += tlen;
+      if (qos) {
+        if (end - p < 2) {
+          *err = ERR_TRUNCATED;
+          *consumed = pos;
+          return n;
+        }
+        m[5] = (static_cast<int64_t>(buf[p]) << 8) | buf[p + 1];
+        p += 2;
+      }
+      if (is_v5) {
+        // properties: varint length + content
+        int64_t pmult = 1, plen = 0, q = p;
+        bool pdone = false;
+        while (q < end) {
+          const uint8_t b = buf[q];
+          plen += static_cast<int64_t>(b & 0x7F) * pmult;
+          ++q;
+          if (!(b & 0x80)) {
+            pdone = true;
+            break;
+          }
+          pmult *= 128;
+          if (pmult > 128LL * 128 * 128) break;
+        }
+        if (!pdone || end - q < plen) {
+          *err = ERR_BAD_PROPS;
+          *consumed = pos;
+          return n;
+        }
+        m[6] = p;
+        m[7] = (q - p) + plen;
+        p = q + plen;
+      } else {
+        m[6] = -1;
+      }
+      m[8] = p;
+      m[9] = end - p;
+    }
+    ++n;
+    pos = i + blen;
+  }
+  *consumed = pos;
+  return n;
+}
+
+// Topic / topic-filter validation (core/topic.py topic_valid/filter_valid,
+// reference topic.rs Topic::is_valid). Levels split on '/'; UTF-8 passes
+// through untouched ('+'/'#'/'$' are ASCII, safe to scan bytewise).
+// is_filter: 1 = subscription filter (wildcards allowed per spec rules),
+// 0 = publish topic name (no wildcards; '$' only in the first level).
+int rt_topic_validate(const uint8_t* s, int64_t len, int is_filter) {
+  if (len <= 0) return 0;
+  int64_t lev_start = 0;
+  int level_idx = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || s[i] == '/') {
+      const int64_t lev_len = i - lev_start;
+      const uint8_t* lev = s + lev_start;
+      if (is_filter) {
+        for (int64_t j = 0; j < lev_len; ++j) {
+          if (lev[j] == '+' && lev_len != 1) return 0;
+          if (lev[j] == '#') {
+            if (lev_len != 1) return 0;
+            if (i != len) return 0;  // '#' only as the last level
+          }
+        }
+        // '$'-metadata levels only valid first (topic.rs:237-243)
+        if (lev_len > 0 && lev[0] == '$' && level_idx != 0) return 0;
+      } else {
+        for (int64_t j = 0; j < lev_len; ++j) {
+          if (lev[j] == '+' || lev[j] == '#') return 0;
+        }
+        if (lev_len > 0 && lev[0] == '$' && level_idx != 0) return 0;
+      }
+      lev_start = i + 1;
+      ++level_idx;
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"

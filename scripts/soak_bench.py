@@ -99,7 +99,9 @@ async def main():
 
         sw.write(sc.encode(pk.Subscribe(next_pid(), [("soak/t", pk.SubOpts(qos=0))])))
         await sw.drain()
-        await sr.read(64)  # suback
+        while True:  # consume through the codec so a split frame can't desync
+            if any(isinstance(p, pk.Suback) for p in sc.feed(await sr.read(4096))):
+                break
         pr, pw, pcodec = await open_one(args.broker_port, "soak-pub")
         t0 = time.perf_counter()
         pw.write(pcodec.encode(pk.Publish(topic="soak/t", payload=b"alive")))
