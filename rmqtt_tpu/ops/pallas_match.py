@@ -1,0 +1,116 @@
+"""Pallas TPU kernel for the partitioned-match inner loop.
+
+Replaces the ``lax.scan`` body of `ops/partitioned.py::match_partitioned_impl`
+(gather chunk tile → level match → pack bits) with a hand-pipelined kernel:
+per (topic, candidate-chunk) step, the [CHUNK, L+3] filter tile is DMA'd
+HBM→VMEM double-buffered while the previous tile is matched and bit-packed,
+so the tile never materializes as an XLA intermediate and DMA overlaps
+compute. Grid = one program per ``BT`` topics; candidate chunk ids ride in
+SMEM (they are DMA indices, i.e. scalars).
+
+Semantics are identical to the lax path (same [B, NC*WPC] packed words);
+`PartitionedMatcher` verifies that on-device at first use and falls back if
+anything disagrees — an unprofiled kernel must never change routing results.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rmqtt_tpu.ops.encode import PLUS_TOK
+
+BT = 8  # topics per program
+
+
+def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
+            cid_ref, rows_hbm, out_ref):
+    wpc = chunk // 32
+    total = BT * nc
+
+    def body(scratch, sems):
+        def make_dma(slot, idx):
+            t = idx // nc
+            k = idx % nc
+            cid = cid_ref[t, k]
+            return pltpu.make_async_copy(
+                rows_hbm.at[cid], scratch.at[slot], sems.at[slot]
+            )
+
+        make_dma(0, 0).start()
+
+        def step(idx, _):
+            slot = idx % 2
+
+            @pl.when(idx + 1 < total)
+            def _():
+                make_dma((idx + 1) % 2, idx + 1).start()
+
+            make_dma(slot, idx).wait()
+            t = idx // nc
+            k = idx % nc
+            tile = scratch[slot]  # [CHUNK, L+3] int32
+            ftok = tile[:, :lvl]
+            flen = tile[:, lvl]
+            plen = tile[:, lvl + 1]
+            flags = tile[:, lvl + 2]
+            trow = ttok_ref[pl.ds(t, 1), :]  # [1, L]
+            eq = ftok == trow
+            plus = ftok == PLUS_TOK
+            beyond = (
+                lax.broadcasted_iota(jnp.int32, (chunk, lvl), 1) >= plen[:, None]
+            )
+            prefix_ok = jnp.all(eq | plus | beyond, axis=1)  # [CHUNK]
+            hh = (flags & 1) != 0
+            fw = (flags & 2) != 0
+            tl = tlen_ref[t]
+            len_ok = jnp.where(hh, tl >= plen, tl == flen)
+            dollar_ok = jnp.logical_not((tdollar_ref[t] != 0) & fw)
+            m = prefix_ok & len_ok & dollar_ok
+            bit = jnp.left_shift(
+                jnp.uint32(1),
+                lax.broadcasted_iota(jnp.uint32, (wpc, 32), 1),
+            )
+            words = jnp.sum(
+                m.reshape(wpc, 32).astype(jnp.uint32) * bit, axis=1,
+                dtype=jnp.uint32,
+            )
+            out_ref[pl.ds(t, 1), pl.ds(k * wpc, wpc)] = words.reshape(1, wpc)
+
+        lax.fori_loop(0, total, step, None)
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((2, chunk, lvl + 3), jnp.int32),
+        sems=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                       interpret: bool = False):
+    """→ packed match words [B, NC*WPC] uint32 (B must be a multiple of BT)."""
+    b, nc = chunk_ids.shape
+    nchunks, chunk, width = packed_rows.shape
+    lvl = width - 3
+    wpc = chunk // 32
+    kernel = functools.partial(_kernel, nc, lvl, chunk)
+    return pl.pallas_call(
+        kernel,
+        grid=(b // BT,),
+        in_specs=[
+            pl.BlockSpec((BT, lvl), lambda i: (i, 0)),
+            pl.BlockSpec((BT,), lambda i: (i,)),
+            pl.BlockSpec((BT,), lambda i: (i,)),
+            pl.BlockSpec((BT, nc), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # packed_rows stays in HBM
+        ],
+        out_specs=pl.BlockSpec((BT, nc * wpc), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nc * wpc), jnp.uint32),
+        interpret=interpret,
+    )(ttok, tlen.astype(jnp.int32), tdollar.astype(jnp.int32), chunk_ids, packed_rows)

@@ -521,8 +521,8 @@ class PartitionedTable:
             return ttok, tlen, tdollar.view(bool), cand, nc_cap
 
 
-def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_words: int):
-    """Gather-based partitioned match → (word_idx, word_bits, counts).
+def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
+    """lax.scan partitioned match → packed words [B, NC*WPC] uint32.
 
     ``packed_rows`` is chunk-tiled ``[nchunks, CHUNK, L+3]`` — per-row level
     tokens followed by (flen, prefix_len, hash|wild flags) so each scan step
@@ -560,7 +560,12 @@ def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_word
         return None, packed  # [B, WPC]
 
     _, words = lax.scan(body, None, jnp.moveaxis(chunk_ids, 0, 1))  # [NC, B, WPC]
-    words = jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
+    return jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
+
+
+def compact_words_impl(words, max_words: int):
+    """Packed words → (word_idx, word_bits, counts) compaction (shared by
+    the lax and Pallas word producers)."""
     counts = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=1)
     w = words.shape[1]
     kw = min(max_words, w)
@@ -570,11 +575,25 @@ def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_word
     return word_idx, word_bits, counts
 
 
+def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_words: int):
+    """Gather-based partitioned match → (word_idx, word_bits, counts)."""
+    words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
+    return compact_words_impl(words, max_words)
+
+
 _match_partitioned = jax.jit(match_partitioned_impl, static_argnames=("max_words",))
+_compact_words = jax.jit(compact_words_impl, static_argnames=("max_words",))
 
 
 class PartitionedMatcher:
-    """Device mirror + batched match over a ``PartitionedTable``."""
+    """Device mirror + batched match over a ``PartitionedTable``.
+
+    On TPU the inner loop can run as a hand-pipelined Pallas kernel
+    (`ops/pallas_match.py`); it is enabled only after an on-device
+    self-check against the lax path agrees (env ``RMQTT_PALLAS=0/1``
+    forces it off/on) — routing results must never depend on an
+    unverified kernel.
+    """
 
     def __init__(self, table: PartitionedTable, device=None, max_words: int = 32) -> None:
         self.table = table
@@ -582,6 +601,55 @@ class PartitionedMatcher:
         self.max_words = max_words
         self._dev_version = -1
         self._dev_arrays = None
+        self._pallas: Optional[bool] = None  # None = not decided yet
+        self._pallas_interpret = False  # CPU (tests): run the kernel interpreted
+
+    def _decide_pallas(self, dev, ttok, tlen, tdollar, chunk_ids) -> bool:
+        import logging
+        import os
+
+        env = os.environ.get("RMQTT_PALLAS", "")
+        if env == "0":
+            return False
+        platform = next(iter(dev.devices())).platform if hasattr(dev, "devices") else ""
+        if platform != "tpu" and env != "1":
+            return False
+        log = logging.getLogger("rmqtt_tpu.ops")
+        try:
+            from rmqtt_tpu.ops.pallas_match import match_words_pallas
+
+            self._pallas_interpret = platform != "tpu"
+            got = np.asarray(
+                match_words_pallas(dev, ttok, tlen, tdollar, chunk_ids,
+                                   interpret=self._pallas_interpret)
+            )
+            want = np.asarray(
+                jax.jit(scan_words_impl)(dev, ttok, tlen, tdollar, chunk_ids)
+            )
+            if not np.array_equal(got, want):
+                log.warning("pallas match kernel disagrees with lax path; disabled")
+                return False
+            log.info("pallas match kernel verified on %s; enabled", platform)
+            return True
+        except Exception as e:  # compile/runtime failure: stay on lax
+            log.warning("pallas match kernel unavailable (%s); using lax path", e)
+            return False
+
+    def _words(self, dev, ttok, tlen, tdollar, chunk_ids):
+        from rmqtt_tpu.ops.pallas_match import BT
+
+        if chunk_ids.shape[0] % BT:
+            return None  # pallas grid needs a BT-multiple batch
+        if self._pallas is None:
+            self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar, chunk_ids)
+        if self._pallas:
+            from rmqtt_tpu.ops.pallas_match import match_words_pallas
+
+            return match_words_pallas(
+                dev, ttok, tlen, tdollar, chunk_ids,
+                interpret=self._pallas_interpret,
+            )
+        return None
 
     def _refresh(self):
         t = self.table
@@ -612,15 +680,32 @@ class PartitionedMatcher:
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         b = len(topics)
-        padded = 1 << (b - 1).bit_length() if (pad_to_pow2 and b > 1) else b
+        if pad_to_pow2:
+            padded = 1 << (b - 1).bit_length() if b > 1 else b
+            if self._pallas is not False:
+                # pad to the pallas grid multiple only while that backend is
+                # (possibly) in play — the lax path must not pay 8x on
+                # single-topic matches after pallas is ruled out
+                try:
+                    from rmqtt_tpu.ops.pallas_match import BT
+
+                    padded = max(BT, padded)
+                except ImportError:
+                    self._pallas = False
+        else:
+            padded = b
         ttok, tlen, tdollar, chunk_ids, _nc = self.table.encode_topics(
             topics, pad_batch_to=padded
         )
         dev = self._refresh()
+        words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         while True:
-            wi, wb, cn = _match_partitioned(
-                dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
-            )
+            if words is not None:
+                wi, wb, cn = _compact_words(words, max_words=self.max_words)
+            else:
+                wi, wb, cn = _match_partitioned(
+                    dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
+                )
             wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
             if int(cn[:b].max(initial=0)) <= self.max_words:
                 break
