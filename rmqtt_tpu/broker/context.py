@@ -176,7 +176,14 @@ class ServerContext:
         s.delayed_publishs = len(self.delayed)
         s.topics = self.router.topics_count()
         s.routes = self.router.routes_count()
+        s.handshakings = self.metrics.get("connections.established")
+        s.handshakings_active = self.hs_executor.active_count()
+        s.handshakings_rate = int(self.handshake_rate.rate() * 100)
+        s.forwards = self.metrics.get("cluster.forwards")
+        s.message_storages = self.metrics.get("storage.messages_stored")
+        s.subscriptions_shared = self.router.shared_groups_count()
         for sess in self.registry.sessions():
             s.message_queues += len(sess.deliver_queue)
             s.out_inflights += len(sess.out_inflight)
+            s.in_inflights += len(sess.in_qos2)
         return s

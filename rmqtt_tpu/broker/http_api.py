@@ -168,6 +168,9 @@ class HttpApi:
     async def _dispatch(self, method: str, path: str, q, body: bytes) -> Tuple[int, Any, str]:
         ctx = self.ctx
         J = "application/json"
+        if path in ("", "/index.html", "/dashboard"):  # note: "/" rstrips to ""
+            # static admin dashboard (api.rs:73-203 serves one embedded)
+            return 200, _DASHBOARD_HTML, "text/html; charset=utf-8"
         if path in ("/api/v1", "/api/v1/"):
             return 200, [
                 "/api/v1/brokers", "/api/v1/nodes", "/api/v1/health",
@@ -300,3 +303,57 @@ class HttpApi:
             lines.append(f"# TYPE {name} counter")
             lines.append(f'{name}{{node="{self.ctx.node_id}"}} {v}')
         return "\n".join(lines) + "\n"
+
+
+# Embedded admin dashboard (the reference's http-api serves a static UI,
+# api.rs:73-203). Single file, no external assets: polls the JSON API.
+_DASHBOARD_HTML = b"""<!doctype html>
+<html><head><meta charset="utf-8"><title>rmqtt_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+ .cards{display:flex;flex-wrap:wrap;gap:.6rem}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:.6rem 1rem;min-width:9rem}
+ .card .v{font-size:1.4rem;font-weight:600} .card .k{color:#666;font-size:.8rem}
+ table{border-collapse:collapse;background:#fff;width:100%}
+ th,td{border:1px solid #ddd;padding:.3rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} #err{color:#b00020}
+</style></head><body>
+<h1>rmqtt_tpu broker <span id="node"></span></h1><div id="err"></div>
+<div class="cards" id="stats"></div>
+<h2>Clients</h2><table id="clients"><thead><tr>
+<th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
+<th>subs</th><th>queue</th><th>inflight</th></tr></thead><tbody></tbody></table>
+<h2>Subscriptions</h2><table id="subs"><thead><tr>
+<th>client id</th><th>topic filter</th><th>qos</th></tr></thead><tbody></tbody></table>
+<script>
+const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
+ "topics","routes","retaineds","delayed_publishs","message_queues",
+ "out_inflights","in_inflights","handshakings","handshakings_active",
+ "handshakings_rate","forwards","message_storages"];
+async function j(p){const r=await fetch(p);if(!r.ok)throw new Error(p+": "+r.status);return r.json()}
+// client ids / topics / usernames are ATTACKER-CHOSEN (any MQTT client);
+// everything interpolated into markup must be escaped
+const esc=v=>String(v??"").replace(/[&<>"']/g,
+ ch=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+async function tick(){
+ try{
+  const stats=await j("/api/v1/stats");
+  const mine=stats[0]||{};
+  document.getElementById("node").textContent="(node "+(mine.node??"?")+")";
+  const agg={};for(const n of stats){for(const k of KEYS){agg[k]=(agg[k]||0)+((n.stats||{})[k]||0)}}
+  document.getElementById("stats").innerHTML=KEYS.map(k=>
+   `<div class="card"><div class="v">${esc(agg[k]??0)}</div><div class="k">${esc(k)}</div></div>`).join("");
+  const clients=await j("/api/v1/clients?_limit=50");
+  document.querySelector("#clients tbody").innerHTML=clients.map(c=>
+   `<tr><td>${esc(c.clientid)}</td><td>${esc(c.node_id)}</td><td>${esc(c.ip)}</td><td>${esc(c.protocol)}</td>
+    <td>${esc(c.connected)}</td><td>${esc(c.subscriptions)}</td><td>${esc(c.mqueue_len)}</td><td>${esc(c.inflight)}</td></tr>`).join("");
+  const subs=await j("/api/v1/subscriptions?_limit=50");
+  document.querySelector("#subs tbody").innerHTML=subs.map(s=>
+   `<tr><td>${esc(s.client_id)}</td><td>${esc(s.topic_filter)}</td><td>${esc(s.qos)}</td></tr>`).join("");
+  document.getElementById("err").textContent="";
+ }catch(e){document.getElementById("err").textContent=String(e)}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""

@@ -127,6 +127,9 @@ class InInflight:
         self.max_size = max_size
         self._ids: set[int] = set()
 
+    def __len__(self) -> int:
+        return len(self._ids)
+
     def add(self, packet_id: int) -> bool:
         """False if the window is full. Callers must check ``packet_id in
         self`` first for the duplicate case (which needs a PUBREC reply,

@@ -43,6 +43,15 @@ class Stats:
         self.message_queues = 0
         self.topics = 0
         self.routes = 0
+        # rate/handshake surfaces (stats.rs:75-80,221): completed total,
+        # in-flight negotiations, completion rate (ops/sec x 100 like the
+        # reference's integer encoding)
+        self.handshakings = 0
+        self.handshakings_active = 0
+        self.handshakings_rate = 0
+        # cluster forwarding ops + stored offline messages (stats.rs:95-98)
+        self.forwards = 0
+        self.message_storages = 0
 
     def to_json(self) -> Dict[str, int]:
         return dict(vars(self))

@@ -222,6 +222,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     "p2p": None,
                 })
                 count += len(rels)
+                self.ctx.metrics.inc("cluster.forwards")
             except PeerUnavailable:
                 log.warning("ForwardsTo to node %s failed", node_id)
         return count

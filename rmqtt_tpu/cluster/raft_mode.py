@@ -174,6 +174,7 @@ class RaftSessionRegistry(ClusterRegistryBase):
                     "p2p": None,
                 })
                 count += len(rels)
+                self.ctx.metrics.inc("cluster.forwards")
             except PeerUnavailable:
                 log.warning("raft ForwardsTo to node %s failed", node_id)
         return count

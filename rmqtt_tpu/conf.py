@@ -183,6 +183,7 @@ PLUGIN_REGISTRY: Dict[str, str] = {
     "rmqtt-bridge-egress-nats": "rmqtt_tpu.plugins.bridge_nats:BridgeEgressNatsPlugin",
     "rmqtt-bridge-ingress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeIngressKafkaPlugin",
     "rmqtt-bridge-egress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeEgressKafkaPlugin",
+    "rmqtt-bridge-egress-reductstore": "rmqtt_tpu.plugins.bridge_reductstore:BridgeEgressReductstorePlugin",
 }
 
 

@@ -22,37 +22,14 @@ log = logging.getLogger("rmqtt_tpu.auth_http")
 
 async def http_post_form(url: str, params: Dict[str, str], timeout: float = 5.0):
     """→ (status, body) with an x-www-form-urlencoded POST (reference default)."""
-    u = urlparse(url)
-    port = u.port or (443 if u.scheme == "https" else 80)
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(u.hostname, port), timeout
+    from rmqtt_tpu.utils import httpc
+
+    status, payload = await httpc.request(
+        url, "POST", body=urlencode(params).encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        timeout=timeout, read_body=True,
     )
-    try:
-        body = urlencode(params).encode()
-        path = u.path or "/"
-        writer.write(
-            f"POST {path} HTTP/1.1\r\nHost: {u.hostname}\r\n"
-            f"Content-Type: application/x-www-form-urlencoded\r\n"
-            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
-        )
-        await writer.drain()
-        status = int((await asyncio.wait_for(reader.readline(), timeout)).split()[1])
-        # headers
-        length = 0
-        while True:
-            line = await reader.readline()
-            if line in (b"\r\n", b""):
-                break
-            k, _, v = line.decode("latin1").partition(":")
-            if k.strip().lower() == "content-length":
-                length = int(v)
-        payload = await reader.readexactly(length) if length else b""
-        return status, payload.decode("utf-8", "replace")
-    finally:
-        try:
-            writer.close()
-        except Exception:
-            pass
+    return status, payload.decode("utf-8", "replace")
 
 
 class AuthHttpPlugin(Plugin):

@@ -48,6 +48,7 @@ class MessageStoragePlugin(Plugin):
                 return None
             ttl = msg.expiry_interval or self.default_expiry
             self.store.put(NS_MSG, str(next(self._msg_id)), msg_to_wire(msg), ttl=ttl)
+            self.ctx.metrics.inc("storage.messages_stored")
             return None
 
         async def on_subscribed(_ht, args, _prev):

@@ -30,37 +30,13 @@ DEFAULT_EVENTS = [
 
 
 async def http_post_json(url: str, obj: dict, timeout: float = 5.0) -> int:
-    u = urlparse(url)
-    port = u.port or (443 if u.scheme == "https" else 80)
-    if u.scheme == "https":
-        import ssl
+    from rmqtt_tpu.utils import httpc
 
-        sslctx = ssl.create_default_context()
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(u.hostname, port, ssl=sslctx), timeout
-        )
-    else:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(u.hostname, port), timeout
-        )
-    try:
-        body = json.dumps(obj).encode()
-        path = u.path or "/"
-        if u.query:
-            path += "?" + u.query
-        writer.write(
-            f"POST {path} HTTP/1.1\r\nHost: {u.hostname}\r\n"
-            f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n"
-            f"Connection: close\r\n\r\n".encode() + body
-        )
-        await writer.drain()
-        status_line = await asyncio.wait_for(reader.readline(), timeout)
-        return int(status_line.split()[1])
-    finally:
-        try:
-            writer.close()
-        except Exception:
-            pass
+    status, _ = await httpc.request(
+        url, "POST", body=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, timeout=timeout,
+    )
+    return status
 
 
 class WebHookPlugin(Plugin):

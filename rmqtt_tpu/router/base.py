@@ -122,6 +122,10 @@ class Router(abc.ABC):
         return [self.collapse(raw) for raw in self.matches_batch_raw(items)]
 
     # --- admin / introspection surface (router.rs gets/query/topics) ---
+    def shared_groups_count(self) -> int:
+        """Distinct ($share group, filter) pairs (stats gauge; O(1))."""
+        return len(self._relations.shared_index)
+
     def dump_routes(self):
         """Every route edge as (topic_filter, Id, opts) — snapshot/transfer
         surface (raft compaction serializes the full table through this).

@@ -29,6 +29,18 @@ class RelationsMap:
     def __init__(self) -> None:
         self._map: Dict[str, Dict[ClientId, Tuple[Id, SubscriptionOptions]]] = {}
         self.edge_count = 0
+        # (group, filter) → member count, maintained incrementally so the
+        # stats gauge never walks the full table (stats.rs keeps counters)
+        self.shared_index: Dict[Tuple[str, str], int] = {}
+
+    def _shared_dec(self, topic_filter: str, opts: SubscriptionOptions) -> None:
+        if opts.shared_group:
+            key = (opts.shared_group, topic_filter)
+            n = self.shared_index.get(key, 0) - 1
+            if n <= 0:
+                self.shared_index.pop(key, None)
+            else:
+                self.shared_index[key] = n
 
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> bool:
         """Returns True if the filter is new (needs matcher insertion)."""
@@ -36,8 +48,14 @@ class RelationsMap:
         is_new = rels is None
         if is_new:
             rels = self._map[topic_filter] = {}
-        if id.client_id not in rels:
+        prev = rels.get(id.client_id)
+        if prev is None:
             self.edge_count += 1
+        else:
+            self._shared_dec(topic_filter, prev[1])  # re-subscribe may change group
+        if opts.shared_group:
+            key = (opts.shared_group, topic_filter)
+            self.shared_index[key] = self.shared_index.get(key, 0) + 1
         rels[id.client_id] = (id, opts)
         return is_new
 
@@ -46,6 +64,7 @@ class RelationsMap:
         rels = self._map.get(topic_filter)
         if not rels or id.client_id not in rels:
             return False, False
+        self._shared_dec(topic_filter, rels[id.client_id][1])
         del rels[id.client_id]
         self.edge_count -= 1
         if not rels:

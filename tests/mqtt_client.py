@@ -35,6 +35,7 @@ class TestClient:
         self._pid = 0
         self._task: Optional[asyncio.Task] = None
         self.auto_ack = True
+        self.auto_pubrel = True  # auto-answer PUBREC with PUBREL
         self.closed = asyncio.Event()
         self._alias_map = {}
         # enhanced auth (v5): called with (client, Auth packet) on every AUTH
@@ -134,7 +135,9 @@ class TestClient:
         elif isinstance(p, pk.Puback):
             self._resolve(("puback", p.packet_id), p)
         elif isinstance(p, pk.Pubrec):
-            await self._send(pk.Pubrel(p.packet_id))
+            self._resolve(("pubrec", p.packet_id), p)
+            if self.auto_pubrel:
+                await self._send(pk.Pubrel(p.packet_id))
         elif isinstance(p, pk.Pubcomp):
             self._resolve(("pubcomp", p.packet_id), p)
         elif isinstance(p, pk.Pubrel):

@@ -1,0 +1,214 @@
+"""Protocol-conformance scenarios modeled on the Eclipse Paho interop suite
+(the reference ships its results for the v3.1.1 + v5 suites,
+`/root/reference/README.md:181-226`). These cover the suite's classic
+behaviors not already exercised elsewhere in tests/: overlapping
+subscriptions, keepalive eviction, DUP redelivery after reconnect,
+zero-length client ids, QoS2 exactly-once under duplicate PUBLISH,
+oversized packets, v5 subscription identifiers, retain-handling options,
+and request/response property passthrough."""
+
+import asyncio
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk, props as P
+from rmqtt_tpu.broker.codec.packets import SubOpts
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+
+from tests.mqtt_client import TestClient
+
+
+def conf_test(fn, **cfg):
+    def wrapper():
+        async def run():
+            b = MqttBroker(ServerContext(BrokerConfig(port=0, **cfg)))
+            await b.start()
+            try:
+                await asyncio.wait_for(fn(b), timeout=30.0)
+            finally:
+                await b.stop()
+
+        asyncio.run(run())
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+def _connect(b, cid, **kw):
+    return TestClient.connect(b.port, cid, **kw)
+
+
+@conf_test
+async def test_overlapping_subscriptions(broker):
+    """Paho 'overlapping subscriptions': a publish matching several of one
+    client's subscriptions is delivered once per matching subscription at
+    that subscription's QoS (MQTT-3.3.5-1 allows either; this pins our
+    behavior)."""
+    sub = await _connect(broker, "overlap")
+    await sub.subscribe("ov/#", qos=0)
+    await sub.subscribe("ov/+/x", qos=1)
+    pub = await _connect(broker, "overlap-pub")
+    await pub.publish("ov/a/x", b"both", qos=1)
+    got = [await sub.recv(), await sub.recv()]
+    assert sorted(p.qos for p in got) == [0, 1]
+    assert all(p.payload == b"both" for p in got)
+    await sub.expect_nothing()
+    await sub.disconnect_clean()
+    await pub.disconnect_clean()
+
+
+def test_keepalive_eviction():
+    """A client silent past ~1.5x its keepalive is disconnected
+    (MQTT-3.1.2-24; fitter.rs backoff)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        try:
+            c = await TestClient.connect(b.port, "silent", keepalive=1)
+            # keepalive=1 => timeout 1+3 = 4s (small-value slack); stay silent
+            await asyncio.wait_for(c.closed.wait(), timeout=10.0)
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+@conf_test
+async def test_dup_redelivery_after_reconnect(broker):
+    """Unacked QoS1 deliveries are redelivered with DUP=1 when the session
+    resumes (MQTT-4.4.0-1; paho 'redelivery on reconnect')."""
+    sub = await _connect(broker, "redeliver", version=pk.V5, clean_start=False,
+                         properties={P.SESSION_EXPIRY_INTERVAL: 300})
+    await sub.subscribe("rd/t", qos=1)
+    sub.auto_ack = False  # receive but never PUBACK
+    pub = await _connect(broker, "redeliver-pub")
+    await pub.publish("rd/t", b"retry-me", qos=1)
+    first = await sub.recv()
+    assert first.qos == 1 and not first.dup
+    sub.abort()  # drop without acking
+    await asyncio.sleep(0.2)
+    sub2 = await _connect(broker, "redeliver", version=pk.V5, clean_start=False,
+                          properties={P.SESSION_EXPIRY_INTERVAL: 300})
+    assert sub2.connack.session_present
+    again = await sub2.recv(timeout=10)
+    assert again.payload == b"retry-me"
+    assert again.dup, "redelivery must set DUP"
+    await sub2.disconnect_clean()
+    await pub.disconnect_clean()
+
+
+@conf_test
+async def test_zero_length_clientid(broker):
+    """v3.1.1: empty client id only with clean session (MQTT-3.1.3-7/-8);
+    v5: server assigns an id and reports it."""
+    ok = await _connect(broker, "", clean_start=True)
+    assert ok.connack.reason_code == 0
+    await ok.disconnect_clean()
+    bad = await _connect(broker, "", clean_start=False)
+    assert bad.connack.reason_code == 0x02  # identifier rejected
+    v5 = await _connect(broker, "", version=pk.V5, clean_start=True)
+    assert v5.connack.reason_code == 0
+    assert v5.connack.properties.get(P.ASSIGNED_CLIENT_IDENTIFIER)
+    await v5.disconnect_clean()
+
+
+@conf_test
+async def test_qos2_duplicate_publish_not_redelivered(broker):
+    """Exactly-once: re-sending the same QoS2 packet id with DUP before
+    PUBREL completes must not reach subscribers twice (MQTT-4.3.3-2)."""
+    sub = await _connect(broker, "q2sub")
+    await sub.subscribe("q2/t", qos=2)
+    pub = await _connect(broker, "q2pub")
+    pub.auto_pubrel = False  # drive the QoS2 state machine by hand
+    await pub._send(pk.Publish(topic="q2/t", payload=b"once", qos=2, packet_id=7))
+    await pub._wait(("pubrec", 7), timeout=5.0)
+    # retransmit the same pid with DUP while the exchange is open
+    await pub._send(pk.Publish(topic="q2/t", payload=b"once", qos=2, packet_id=7, dup=True))
+    await pub._wait(("pubrec", 7), timeout=5.0)  # broker re-PUBRECs, no redelivery
+    await pub._send(pk.Pubrel(7))
+    p = await sub.recv()
+    assert p.payload == b"once"
+    await sub.expect_nothing()
+    await sub.disconnect_clean()
+    await pub.disconnect_clean()
+
+
+def test_oversized_packet_rejected():
+    """Inbound frames above the negotiated maximum are a protocol error
+    (MQTT-3.1.2-24 v5 Maximum Packet Size; codec.rs:250 size cap)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, max_packet_size=1024)))
+        await b.start()
+        try:
+            c = await TestClient.connect(b.port, "big")
+            await c._send(pk.Publish(topic="big/t", payload=b"x" * 2048, qos=0))
+            await asyncio.wait_for(c.closed.wait(), timeout=5.0)
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+@conf_test
+async def test_subscription_identifier_v5(broker):
+    """v5 subscription identifiers ride back on matching deliveries
+    (MQTT-3.8.4-6, paho v5 suite)."""
+    sub = await _connect(broker, "sid", version=pk.V5)
+    await sub.subscribe("sid/#", qos=0, properties={P.SUBSCRIPTION_IDENTIFIER: 42})
+    pub = await _connect(broker, "sid-pub", version=pk.V5)
+    await pub.publish("sid/x", b"tagged")
+    p = await sub.recv()
+    ids = p.properties.get(P.SUBSCRIPTION_IDENTIFIER)
+    ids = ids if isinstance(ids, list) else [ids]
+    assert 42 in ids
+    await sub.disconnect_clean()
+    await pub.disconnect_clean()
+
+
+@conf_test
+async def test_retain_handling_options_v5(broker):
+    """v5 Retain Handling: 1 = send retained only on NEW subscriptions,
+    2 = never send retained (MQTT-3.3.1-10/-11)."""
+    pub = await _connect(broker, "rh-pub")
+    await pub.publish("rh/t", b"kept", qos=0, retain=True)
+    sub = await _connect(broker, "rh-sub", version=pk.V5)
+    # rh=2: no retained delivery
+    await sub.subscribe("rh/t", opts=SubOpts(qos=0, retain_handling=2))
+    await sub.expect_nothing()
+    # rh=1 on an EXISTING subscription: still nothing
+    await sub.subscribe("rh/t", opts=SubOpts(qos=0, retain_handling=1))
+    await sub.expect_nothing()
+    # rh=1 on a new subscription (different filter): retained arrives
+    await sub.subscribe("rh/+", opts=SubOpts(qos=0, retain_handling=1))
+    p = await sub.recv()
+    assert p.payload == b"kept" and p.retain
+    await sub.disconnect_clean()
+    await pub.disconnect_clean()
+
+
+@conf_test
+async def test_request_response_properties_v5(broker):
+    """v5 request/response: Response Topic + Correlation Data pass through
+    to subscribers unchanged (MQTT-3.3.2-15/-16)."""
+    responder = await _connect(broker, "resp", version=pk.V5)
+    await responder.subscribe("req/t", qos=1)
+    requester = await _connect(broker, "reqr", version=pk.V5)
+    await requester.subscribe("answers/me", qos=1)
+    await requester.publish(
+        "req/t", b"question", qos=1,
+        properties={P.RESPONSE_TOPIC: "answers/me", P.CORRELATION_DATA: b"c-1"},
+    )
+    q = await responder.recv()
+    assert q.properties.get(P.RESPONSE_TOPIC) == "answers/me"
+    assert q.properties.get(P.CORRELATION_DATA) == b"c-1"
+    await responder.publish(
+        q.properties[P.RESPONSE_TOPIC], b"answer", qos=1,
+        properties={P.CORRELATION_DATA: q.properties[P.CORRELATION_DATA]},
+    )
+    a = await requester.recv()
+    assert a.payload == b"answer"
+    assert a.properties.get(P.CORRELATION_DATA) == b"c-1"
+    await responder.disconnect_clean()
+    await requester.disconnect_clean()

@@ -1,0 +1,94 @@
+"""ReductStore egress bridge against a wire-level HTTP fake."""
+
+import asyncio
+
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.plugins.bridge_reductstore import BridgeEgressReductstorePlugin
+
+from tests.mqtt_client import TestClient
+
+
+class FakeReduct:
+    """Minimal ReductStore HTTP endpoint: bucket create + record write."""
+
+    def __init__(self) -> None:
+        self.buckets = {}
+        self.records = []  # (bucket, entry, ts, labels, body)
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        try:
+            req = await reader.readline()
+            method, target, _ = req.decode().split()
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = await reader.readexactly(int(headers.get("content-length", 0)))
+            path, _, query = target.partition("?")
+            parts = path.strip("/").split("/")  # api v1 b bucket [entry]
+            status = 404
+            if method == "POST" and parts[:3] == ["api", "v1", "b"] and len(parts) == 4:
+                bucket = parts[3]
+                status = 409 if bucket in self.buckets else 200
+                self.buckets[bucket] = body
+            elif method == "POST" and len(parts) == 5:
+                labels = {k[len("x-reduct-label-"):]: v for k, v in headers.items()
+                          if k.startswith("x-reduct-label-")}
+                ts = int(query.split("=", 1)[1]) if query.startswith("ts=") else 0
+                self.records.append((parts[3], parts[4], ts, labels, body))
+                status = 200
+            writer.write(f"HTTP/1.1 {status} X\r\nContent-Length: 0\r\n\r\n".encode())
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+
+def test_reductstore_egress_bridge():
+    async def run():
+        fake = FakeReduct()
+        await fake.start()
+        ctx = ServerContext(BrokerConfig(port=0))
+        ctx.plugins.register(BridgeEgressReductstorePlugin(ctx, {
+            "url": f"http://127.0.0.1:{fake.port}",
+            "forwards": [{"filter": "rs/#", "bucket": "mqtt", "entry": "events",
+                          "quota_size": 1000}],
+        }))
+        b = MqttBroker(ctx)
+        await b.start()
+        try:
+            assert "mqtt" in fake.buckets  # bucket ensured at start
+            pub = await TestClient.connect(b.port, "rs-pub")
+            await pub.publish("rs/dev/1", b"reading=42", qos=1)
+            deadline = asyncio.get_running_loop().time() + 10
+            while not fake.records:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            bucket, entry, ts, labels, body = fake.records[0]
+            assert (bucket, entry) == ("mqtt", "events")
+            assert body == b"reading=42"
+            assert labels["topic"] == "rs/dev/1"
+            assert labels["from_clientid"] == "rs-pub"
+            assert labels["qos"] == "1"
+            assert ts > 0
+            await pub.disconnect_clean()
+        finally:
+            await b.stop()
+            await fake.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
