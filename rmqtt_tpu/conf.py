@@ -184,6 +184,8 @@ PLUGIN_REGISTRY: Dict[str, str] = {
     "rmqtt-bridge-ingress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeIngressKafkaPlugin",
     "rmqtt-bridge-egress-kafka": "rmqtt_tpu.plugins.bridge_kafka:BridgeEgressKafkaPlugin",
     "rmqtt-bridge-egress-reductstore": "rmqtt_tpu.plugins.bridge_reductstore:BridgeEgressReductstorePlugin",
+    "rmqtt-bridge-ingress-pulsar": "rmqtt_tpu.plugins.bridge_pulsar:BridgeIngressPulsarPlugin",
+    "rmqtt-bridge-egress-pulsar": "rmqtt_tpu.plugins.bridge_pulsar:BridgeEgressPulsarPlugin",
 }
 
 

@@ -1,0 +1,213 @@
+"""Pulsar bridge: wire client + ingress/egress plugins against a wire-level
+fake broker speaking the same binary-protocol subset (CONNECT/PRODUCER/
+SEND/SUBSCRIBE/FLOW/MESSAGE/ACK with protobuf commands + payload frames)."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from rmqtt_tpu.bridge.pulsar_client import (
+    ACK,
+    CONNECT,
+    CONNECTED,
+    FLOW,
+    MAGIC,
+    MESSAGE,
+    PRODUCER,
+    PRODUCER_SUCCESS,
+    PulsarClient,
+    SEND,
+    SEND_RECEIPT,
+    SUBSCRIBE,
+    SUCCESS,
+    base_command,
+    frame_payload,
+    frame_simple,
+    message_metadata,
+    pb_bytes,
+    pb_decode,
+    pb_str,
+    pb_varint,
+)
+from rmqtt_tpu.broker.codec import packets as pk, props as P
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.plugins.bridge_pulsar import (
+    BridgeEgressPulsarPlugin,
+    BridgeIngressPulsarPlugin,
+)
+
+from tests.mqtt_client import TestClient
+
+
+class FakePulsar:
+    """Single-connection-at-a-time Pulsar speaking the bridge's subset."""
+
+    def __init__(self) -> None:
+        self.server = None
+        self.port = None
+        self.topics: dict = {}  # topic -> [(props, payload)]
+        self.acked: list = []
+        self.producers: dict = {}  # producer_id -> topic
+        self.consumers: dict = {}  # consumer_id -> topic
+
+    def seed(self, topic, props, payload):
+        self.topics.setdefault(topic, []).append((props, payload))
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        async def send(data):
+            writer.write(data)
+            await writer.drain()
+
+        try:
+            while True:
+                head = await reader.readexactly(4)
+                (total,) = struct.unpack(">I", head)
+                body = await reader.readexactly(total)
+                (csize,) = struct.unpack(">I", body[:4])
+                cmd = pb_decode(body[4 : 4 + csize])
+                ctype = cmd.get(1, [0])[0]
+                sub = pb_decode(cmd[ctype][0]) if ctype in cmd and cmd[ctype] else {}
+                rest = body[4 + csize :]
+                if ctype == CONNECT:
+                    out = bytearray()
+                    pb_str(out, 1, "fake-pulsar")
+                    pb_varint(out, 2, 6)
+                    await send(frame_simple(base_command(CONNECTED, bytes(out))))
+                elif ctype == PRODUCER:
+                    pid, rid = sub[2][0], sub[3][0]
+                    self.producers[pid] = sub[1][0].decode()
+                    out = bytearray()
+                    pb_varint(out, 1, rid)
+                    pb_str(out, 2, f"fake-producer-{pid}")
+                    await send(frame_simple(base_command(PRODUCER_SUCCESS, bytes(out))))
+                elif ctype == SEND:
+                    pid, seq = sub[1][0], sub[2][0]
+                    assert rest[:2] == MAGIC
+                    (msize,) = struct.unpack(">I", rest[6:10])
+                    meta = pb_decode(rest[10 : 10 + msize])
+                    payload = rest[10 + msize :]
+                    props = []
+                    for kv in meta.get(4, []):
+                        d = pb_decode(kv)
+                        props.append((d[1][0].decode(), d[2][0].decode()))
+                    self.topics.setdefault(self.producers[pid], []).append((props, payload))
+                    out = bytearray()
+                    pb_varint(out, 1, pid)
+                    pb_varint(out, 2, seq)
+                    await send(frame_simple(base_command(SEND_RECEIPT, bytes(out))))
+                elif ctype == SUBSCRIBE:
+                    cid, rid = sub[4][0], sub[5][0]
+                    self.consumers[cid] = sub[1][0].decode()
+                    out = bytearray()
+                    pb_varint(out, 1, rid)
+                    await send(frame_simple(base_command(SUCCESS, bytes(out))))
+                elif ctype == FLOW:
+                    cid = sub[1][0]
+                    topic = self.consumers.get(cid)
+                    for n, (props, payload) in enumerate(self.topics.get(topic, [])):
+                        mid = bytearray()
+                        pb_varint(mid, 1, 7)  # ledger
+                        pb_varint(mid, 2, n)  # entry
+                        msg = bytearray()
+                        pb_varint(msg, 1, cid)
+                        pb_bytes(msg, 2, bytes(mid))
+                        meta = message_metadata("fake-producer", n, props)
+                        await send(frame_payload(base_command(MESSAGE, bytes(msg)), meta, payload))
+                elif ctype == ACK:
+                    self.acked.append(sub[3][0])
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+def test_pulsar_client_roundtrip():
+    async def run():
+        fake = FakePulsar()
+        await fake.start()
+        try:
+            c = PulsarClient("127.0.0.1", fake.port)
+            await c.connect()
+            name = await c.create_producer("persistent://public/default/t1", producer_id=1)
+            assert name == "fake-producer-1"
+            await c.send(1, 1, b"hello", properties=[("k", "v")], partition_key="pk")
+            assert fake.topics["persistent://public/default/t1"][0] == ([("k", "v")], b"hello")
+            got = []
+
+            async def on_msg(cid, mid, props, payload):
+                got.append((cid, props, payload))
+                await c.ack(cid, mid)
+
+            c.on_message = on_msg
+            await c.subscribe("persistent://public/default/t1", "subA", consumer_id=2,
+                              initial_position="earliest")
+            await c.flow(2, 100)
+            deadline = asyncio.get_running_loop().time() + 5
+            while not got:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            assert got[0][1] == [("k", "v")] and got[0][2] == b"hello"
+            await asyncio.sleep(0.1)
+            assert fake.acked, "ack never reached the broker"
+            await c.close()
+        finally:
+            await fake.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_pulsar_bridge_ingress_and_egress():
+    async def run():
+        fake = FakePulsar()
+        await fake.start()
+        fake.seed("persistent://public/default/cmds", [("corr", "xyz")], b"do-it")
+        ctx = ServerContext(BrokerConfig(port=0))
+        ctx.plugins.register(BridgeIngressPulsarPlugin(ctx, {
+            "servers": f"127.0.0.1:{fake.port}",
+            "subscribes": [{"topic": "persistent://public/default/cmds",
+                            "subscription": "rmqtt", "initial_position": "earliest",
+                            "local_topic": "pulsar/cmds", "qos": 0}],
+        }))
+        ctx.plugins.register(BridgeEgressPulsarPlugin(ctx, {
+            "servers": f"127.0.0.1:{fake.port}",
+            "forwards": [{"filter": "pl/#",
+                          "remote_topic": "persistent://public/default/events",
+                          "partition_key": "dev"}],
+        }))
+        b = MqttBroker(ctx)
+        await b.start()
+        try:
+            sub = await TestClient.connect(b.port, "plsub", version=pk.V5)
+            await sub.subscribe("pulsar/#", qos=0)
+            p = await sub.recv(timeout=10)
+            assert (p.topic, p.payload) == ("pulsar/cmds", b"do-it")
+            uprops = dict(p.properties.get(P.USER_PROPERTY, []))
+            assert uprops.get("corr") == "xyz"
+
+            pub = await TestClient.connect(b.port, "plpub")
+            await pub.publish("pl/a", b"state", qos=1)
+            deadline = asyncio.get_running_loop().time() + 10
+            while "persistent://public/default/events" not in fake.topics:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            props, payload = fake.topics["persistent://public/default/events"][0]
+            assert payload == b"state"
+            assert ("mqtt_topic", "pl/a") in props
+            assert ("from_clientid", "plpub") in props
+            await sub.disconnect_clean()
+            await pub.disconnect_clean()
+        finally:
+            await b.stop()
+            await fake.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 45))
