@@ -247,6 +247,45 @@ def spot_check(table, fids, tree, topics, n=32):
 # ---------------------------------------------------------------- configs
 
 
+_PROFILE_DIR = None  # set by main --profile; traces the DEVICE phase only
+
+
+class _DeviceProfile:
+    """Profile just the measured device phase — a trace spanning the
+    minutes of data generation / CPU baselines would bury the kernels.
+    Profiler failures (unwritable dir, double-start) must never fail the
+    bench: they log and measurement continues untraced."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        if _PROFILE_DIR is None:
+            return self
+        try:
+            import jax
+
+            self._cm = jax.profiler.trace(f"{_PROFILE_DIR}/{self.name}")
+            self._cm.__enter__()
+        except Exception as e:
+            log(f"profiler unavailable ({e}); continuing without trace")
+            self._cm = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            try:
+                self._cm.__exit__(*exc)
+            except Exception as e:
+                log(f"profiler stop failed ({e})")
+        return False
+
+
+def _device_profile(name):
+    return _DeviceProfile(name)
+
+
 def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
     log(f"[{name}] {len(filters)} subs, {len(topics)} publish topics")
     tree = build_cpu_tree(filters)
@@ -258,9 +297,10 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
     for kind in ("partitioned", "dense"):
         table, fids = build_tpu_table(filters, kind)
         spot_check(table, fids, tree, topics)
-        variants[kind] = measure_tpu(table, topics, batch_size)
-        if retained is not None and kind == "dense":
-            variants["retained"] = run_retained(table, retained, topics)
+        with _device_profile(f"{name}_{kind}"):
+            variants[kind] = measure_tpu(table, topics, batch_size)
+            if retained is not None and kind == "dense":
+                variants["retained"] = run_retained(table, retained, topics)
         del table, fids
     best_kind = max(("partitioned", "dense"), key=lambda k: variants[k]["topics_per_sec"])
     tpu = variants[best_kind]
@@ -333,6 +373,11 @@ def main():
     ap.add_argument("--config", type=int, default=None, help="run a single config 1-5")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
+    ap.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture an XLA/device profile of the measured configs into DIR "
+             "(view with tensorboard / xprof; stats.rs-era tracing analogue)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -362,6 +407,9 @@ def main():
         return i <= 3 or args.full or on_tpu
 
     failures = {}
+    if args.profile:
+        global _PROFILE_DIR
+        _PROFILE_DIR = args.profile
 
     def guarded(name, fn):
         """A late config failing (OOM at 10M subs, driver timeout nearing)
