@@ -84,15 +84,21 @@ class RoutingService:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        # CPU routers (trie/native) match in microseconds: a thread-pool hop
+        # per dispatch costs more than the match itself and caps serial
+        # publish throughput. Device routers keep the executor (the kernel
+        # blocks; numpy/jax release the GIL for the heavy parts).
+        inline = getattr(self.router, "prefer_inline", False)
         while True:
             batch = await self._collect()
             items = [(fid, topic) for fid, topic, _, _ in batch]
             try:
-                # matches_batch_raw blocks on device compute; keep the event
-                # loop free (numpy/jax release the GIL for the heavy parts)
-                results = await loop.run_in_executor(
-                    None, self.router.matches_batch_raw, items
-                )
+                if inline and len(items) <= 256:
+                    results = self.router.matches_batch_raw(items)
+                else:
+                    results = await loop.run_in_executor(
+                        None, self.router.matches_batch_raw, items
+                    )
             except Exception as e:  # resolve all waiters with the error
                 for _, _, fut, _ in batch:
                     if not fut.done():

@@ -291,7 +291,18 @@ class SessionState:
         data = self.codec.encode(packet)
         async with self._wlock:
             self.writer.write(data)
-            await self.writer.drain()
+            # drain only under backpressure: an await per delivered message
+            # halves throughput, and asyncio buffers safely below the
+            # high-water mark (the 64KB gate bounds growth between drains).
+            # Writers that only flush ON drain (WsWriter) keep draining
+            # every send.
+            transport = getattr(self.writer, "transport", None)
+            if (
+                getattr(self.writer, "buffers_until_drain", False)
+                or transport is None
+                or transport.get_write_buffer_size() > 64 * 1024
+            ):
+                await self.writer.drain()
 
     async def close(self, kicked: bool = False) -> None:
         self._kicked = self._kicked or kicked

@@ -136,6 +136,10 @@ class WsReader:
 class WsWriter:
     """Duck-typed StreamWriter sending WS binary frames (server: unmasked)."""
 
+    # bytes only reach the wire on drain() (a whole WS frame per drain):
+    # callers must NOT elide drains the way they may for raw StreamWriters
+    buffers_until_drain = True
+
     def __init__(self, writer: asyncio.StreamWriter) -> None:
         self._writer = writer
         self._pending = bytearray()

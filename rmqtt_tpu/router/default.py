@@ -25,6 +25,8 @@ from rmqtt_tpu.router.relations import RelationsMap, expand_matches_raw
 
 
 class DefaultRouter(Router):
+    prefer_inline = True  # trie match is µs-scale: no executor hop needed
+
     def __init__(
         self,
         shared_choice: Optional[SharedChoiceFn] = None,

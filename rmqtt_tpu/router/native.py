@@ -24,6 +24,8 @@ from rmqtt_tpu.runtime import NativeTrie
 
 
 class NativeRouter(Router):
+    prefer_inline = True  # C++ trie match is µs-scale: no executor hop
+
     def __init__(
         self,
         shared_choice: Optional[SharedChoiceFn] = None,

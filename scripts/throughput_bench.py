@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""End-to-end broker message throughput: raw-socket publishers/subscribers
+against a real broker process (BASELINE.md context: the reference reports
+~150K msg/s on 4 cores; this host is 1 core shared between broker AND the
+bench clients, so figures here are a floor for per-core throughput).
+
+Scenarios: 1→1 pipe, 1→N fan-out, N→1 fan-in (all QoS0 — the throughput
+path; QoS1 adds one ack per message on the same machinery).
+
+Usage: python scripts/throughput_bench.py [--msgs 20000] [--port 18910]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk  # noqa: E402
+
+
+async def connect(port, cid):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    codec = MqttCodec()
+    writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+    await writer.drain()
+    while True:
+        for p in codec.feed(await reader.read(4096)):
+            if isinstance(p, pk.Connack):
+                return reader, writer, codec
+
+
+async def subscribe(conn, tf):
+    reader, writer, codec = conn
+    writer.write(codec.encode(pk.Subscribe(1, [(tf, pk.SubOpts(qos=0))])))
+    await writer.drain()
+    while True:
+        for p in codec.feed(await reader.read(4096)):
+            if isinstance(p, pk.Suback):
+                return
+
+
+async def drain_publishes(conn, want, deadline):
+    reader, _w, codec = conn
+    got = 0
+    while got < want:
+        data = await asyncio.wait_for(reader.read(1 << 16), deadline - time.monotonic())
+        if not data:
+            raise ConnectionError("subscriber closed")
+        got += sum(1 for p in codec.feed(data) if isinstance(p, pk.Publish))
+    return got
+
+
+async def blast(conn, topic, n, payload=b"x" * 64):
+    _r, writer, codec = conn
+    frame = codec.encode(pk.Publish(topic=topic, payload=payload, qos=0))
+    # batch writes so the bench client isn't the syscall bottleneck
+    batch = frame * 64
+    full, rest = divmod(n, 64)
+    for _ in range(full):
+        writer.write(batch)
+        if writer.transport.get_write_buffer_size() > 1 << 20:
+            await writer.drain()
+    writer.write(frame * rest)
+    await writer.drain()
+
+
+async def scenario_pipe(port, msgs):
+    sub = await connect(port, "tp-sub")
+    await subscribe(sub, "tp/pipe")
+    pub = await connect(port, "tp-pub")
+    t0 = time.monotonic()
+    deadline = t0 + 120
+    task = asyncio.create_task(drain_publishes(sub, msgs, deadline))
+    await blast(pub, "tp/pipe", msgs)
+    await task
+    dt = time.monotonic() - t0
+    print(f"1->1 pipe:    {msgs} msgs in {dt:.2f}s = {msgs / dt:,.0f} msg/s")
+
+
+async def scenario_fanout(port, msgs, nsubs=50):
+    subs = []
+    for i in range(nsubs):
+        c = await connect(port, f"tp-fo-{i}")
+        await subscribe(c, "tp/fanout")
+        subs.append(c)
+    pub = await connect(port, "tp-fo-pub")
+    per_pub = msgs // nsubs
+    t0 = time.monotonic()
+    deadline = t0 + 120
+    tasks = [asyncio.create_task(drain_publishes(c, per_pub, deadline)) for c in subs]
+    await blast(pub, "tp/fanout", per_pub)
+    await asyncio.gather(*tasks)
+    dt = time.monotonic() - t0
+    delivered = per_pub * nsubs
+    print(f"1->{nsubs} fanout: {per_pub} pubs -> {delivered} deliveries in {dt:.2f}s "
+          f"= {delivered / dt:,.0f} deliveries/s")
+
+
+async def scenario_fanin(port, msgs, npubs=50):
+    sub = await connect(port, "tp-fi-sub")
+    await subscribe(sub, "tp/fanin/#")
+    pubs = [await connect(port, f"tp-fi-{i}") for i in range(npubs)]
+    per_pub = msgs // npubs
+    t0 = time.monotonic()
+    deadline = t0 + 120
+    task = asyncio.create_task(drain_publishes(sub, per_pub * npubs, deadline))
+    await asyncio.gather(*(blast(p, f"tp/fanin/{i}", per_pub) for i, p in enumerate(pubs)))
+    await task
+    dt = time.monotonic() - t0
+    print(f"{npubs}->1 fanin:  {per_pub * npubs} msgs in {dt:.2f}s = {per_pub * npubs / dt:,.0f} msg/s")
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msgs", type=int, default=20_000)
+    ap.add_argument("--port", type=int, default=18910)
+    args = ap.parse_args()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(args.port)],
+        cwd=str(Path(__file__).resolve().parent.parent),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(100):
+            try:
+                with socket.create_connection(("127.0.0.1", args.port), timeout=0.3):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        await scenario_pipe(args.port, args.msgs)
+        await scenario_fanout(args.port, args.msgs)
+        await scenario_fanin(args.port, args.msgs)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
