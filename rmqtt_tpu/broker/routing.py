@@ -88,7 +88,7 @@ class RoutingService:
         # per dispatch costs more than the match itself and caps serial
         # publish throughput. Device routers keep the executor (the kernel
         # blocks; numpy/jax release the GIL for the heavy parts).
-        inline = getattr(self.router, "prefer_inline", False)
+        inline = self.router.prefer_inline
         while True:
             batch = await self._collect()
             items = [(fid, topic) for fid, topic, _, _ in batch]

@@ -85,6 +85,11 @@ def round_robin_choice_factory() -> SharedChoiceFn:
 class Router(abc.ABC):
     """The swappable routing seam (reference router.rs:65-112)."""
 
+    # True for µs-scale CPU matchers (trie/C++): the RoutingService then
+    # dispatches small batches inline instead of paying a thread-pool hop;
+    # device-backed routers leave this False (their kernels block)
+    prefer_inline: bool = False
+
     @abc.abstractmethod
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
         """Register a subscription (filter already stripped of ``$share``)."""

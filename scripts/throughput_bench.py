@@ -25,25 +25,30 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from rmqtt_tpu.broker.codec import MqttCodec, packets as pk  # noqa: E402
 
 
+async def _read_until(reader, codec, ptype):
+    while True:
+        data = await reader.read(4096)
+        if not data:
+            raise ConnectionError(f"peer closed before {ptype.__name__}")
+        for p in codec.feed(data):
+            if isinstance(p, ptype):
+                return p
+
+
 async def connect(port, cid):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
     codec = MqttCodec()
     writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
     await writer.drain()
-    while True:
-        for p in codec.feed(await reader.read(4096)):
-            if isinstance(p, pk.Connack):
-                return reader, writer, codec
+    await _read_until(reader, codec, pk.Connack)
+    return reader, writer, codec
 
 
 async def subscribe(conn, tf):
     reader, writer, codec = conn
     writer.write(codec.encode(pk.Subscribe(1, [(tf, pk.SubOpts(qos=0))])))
     await writer.drain()
-    while True:
-        for p in codec.feed(await reader.read(4096)):
-            if isinstance(p, pk.Suback):
-                return
+    await _read_until(reader, codec, pk.Suback)
 
 
 async def drain_publishes(conn, want, deadline):
@@ -129,11 +134,15 @@ async def main():
     )
     try:
         for _ in range(100):
+            if proc.poll() is not None:
+                raise RuntimeError(f"broker exited rc={proc.returncode} before listening")
             try:
                 with socket.create_connection(("127.0.0.1", args.port), timeout=0.3):
                     break
             except OSError:
                 time.sleep(0.1)
+        else:
+            raise RuntimeError("broker never started listening")
         await scenario_pipe(args.port, args.msgs)
         await scenario_fanout(args.port, args.msgs)
         await scenario_fanin(args.port, args.msgs)
