@@ -1,0 +1,138 @@
+// Sanitizer test driver for the native runtime (topics.cc, encode.cc,
+// codec.cc). Built with -fsanitize=address,undefined by `make sancheck`
+// (run from tests/test_native.py): exercises every C ABI entry point with
+// normal, boundary, and malformed inputs so leaks, overflows and UB are
+// caught even though the Python test suite runs against the unsanitized
+// library. Thread safety is external by contract (the GIL serializes
+// callers), so the sanitizer story is ASan/UBSan, not TSan.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* rt_trie_new();
+void rt_trie_free(void*);
+int rt_trie_add(void*, const char*, int64_t);
+int rt_trie_remove(void*, const char*, int64_t);
+int64_t rt_trie_size(void*);
+int64_t rt_trie_match(void*, const char*, int64_t*, int64_t);
+int64_t rt_trie_match_batch(void*, const char*, int64_t, int64_t*, int64_t*, int64_t);
+
+void* rt_enc_new();
+void rt_enc_free(void*);
+void rt_enc_add_token(void*, const char*, int32_t, int32_t);
+void rt_enc_cache_clear(void*);
+void rt_enc_cache_put(void*, const char*, int32_t, const int32_t*, int32_t);
+int64_t rt_enc_encode(void*, const char*, int64_t, int32_t, int32_t*, int32_t*,
+                      uint8_t*, int32_t, int32_t*, int32_t*, int32_t*);
+
+int64_t rt_codec_scan(const uint8_t*, int64_t, int32_t, int64_t, int64_t*,
+                      int64_t, int64_t*, int32_t*);
+int rt_topic_validate(const uint8_t*, int64_t, int);
+}
+
+static void test_trie() {
+  void* t = rt_trie_new();
+  assert(rt_trie_add(t, "a/b/c", 1));
+  assert(rt_trie_add(t, "a/+/c", 2));
+  assert(rt_trie_add(t, "a/#", 3));
+  assert(rt_trie_add(t, "#", 4));
+  assert(rt_trie_add(t, "", 5));
+  assert(rt_trie_size(t) == 5);
+  int64_t out[16];
+  int64_t n = rt_trie_match(t, "a/b/c", out, 16);
+  assert(n == 4);
+  n = rt_trie_match(t, "a/b/c", out, 1);  // overflow reporting: n > cap
+  assert(n == 4);
+  assert(rt_trie_remove(t, "a/+/c", 2));
+  assert(!rt_trie_remove(t, "a/+/c", 2));
+  // batch over a blob with empty + deep topics
+  std::string blob;
+  blob += "a/b/c";
+  blob.push_back('\0');
+  blob += "";
+  blob.push_back('\0');
+  blob += "x/y/z/w/v/u/t/s/r/q";
+  blob.push_back('\0');
+  int64_t counts[3];
+  int64_t vals[64];
+  int64_t total = rt_trie_match_batch(t, blob.data(), 3, counts, vals, 64);
+  assert(total >= 0);
+  rt_trie_free(t);
+}
+
+static void test_encoder() {
+  void* e = rt_enc_new();
+  rt_enc_add_token(e, "sensor", 6, 10);
+  rt_enc_add_token(e, "", 0, 11);  // empty level token
+  int32_t chunks[3] = {1, 2, 3};
+  rt_enc_cache_put(e, "sensor/a/b", 10, chunks, 3);
+  std::string blob;
+  blob += "sensor/a/b/c/d";  // cached prefix
+  blob.push_back('\0');
+  blob += "unknown/levels/here";  // miss
+  blob.push_back('\0');
+  blob += "";  // empty topic
+  blob.push_back('\0');
+  const int64_t n = 3;
+  const int32_t lvl = 8, cap = 4;
+  std::vector<int32_t> ttok(n * lvl), tlen(n), cand(n * cap), cnt(n), miss(n);
+  std::vector<uint8_t> dollar(n);
+  int64_t misses = rt_enc_encode(e, blob.data(), n, lvl, ttok.data(), tlen.data(),
+                                 dollar.data(), cap, cand.data(), cnt.data(),
+                                 miss.data());
+  assert(misses == 2);
+  assert(tlen[0] == 5 && cnt[0] == 3);
+  assert(ttok[0] == 10);
+  rt_enc_cache_clear(e);
+  rt_enc_free(e);
+}
+
+static void test_codec() {
+  // a CONNACK (2 bytes) + a v5 PUBLISH qos1 with empty props + trailing junk
+  std::vector<uint8_t> buf = {
+      0x20, 0x02, 0x00, 0x00,                    // CONNACK
+      0x32, 0x0A, 0x00, 0x03, 'a', '/', 'b',     // PUBLISH qos1 topic a/b
+      0x00, 0x07,                                // packet id 7
+      0x00,                                      // props len 0
+      'h', 'i',                                  // payload
+  };
+  int64_t meta[4 * 10];
+  int64_t consumed = 0;
+  int32_t err = 0;
+  int64_t nf = rt_codec_scan(buf.data(), (int64_t)buf.size(), 1, 1 << 20, meta, 4,
+                             &consumed, &err);
+  assert(nf == 2 && err == 0 && consumed == (int64_t)buf.size());
+  assert(meta[10] == 0x32);           // publish first byte
+  assert(meta[10 + 5] == 7);          // packet id
+  assert(meta[10 + 9] == 2);          // payload length
+  // malformed: 5-byte remaining length
+  std::vector<uint8_t> bad = {0x30, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F};
+  nf = rt_codec_scan(bad.data(), (int64_t)bad.size(), 0, 1 << 20, meta, 4,
+                     &consumed, &err);
+  assert(nf == 0 && err == 1);
+  // truncated PUBLISH topic length
+  std::vector<uint8_t> trunc = {0x30, 0x01, 0x00};
+  nf = rt_codec_scan(trunc.data(), (int64_t)trunc.size(), 0, 1 << 20, meta, 4,
+                     &consumed, &err);
+  assert(err == 4);
+  // validation edge cases
+  assert(rt_topic_validate((const uint8_t*)"a/b", 3, 0) == 1);
+  assert(rt_topic_validate((const uint8_t*)"a/+", 3, 0) == 0);
+  assert(rt_topic_validate((const uint8_t*)"#", 1, 1) == 1);
+  assert(rt_topic_validate((const uint8_t*)"#/a", 3, 1) == 0);
+  assert(rt_topic_validate((const uint8_t*)"/", 1, 1) == 1);
+  assert(rt_topic_validate((const uint8_t*)"", 0, 1) == 0);
+}
+
+int main() {
+  test_trie();
+  test_encoder();
+  test_codec();
+  std::puts("runtime sanitizer checks passed");
+  return 0;
+}

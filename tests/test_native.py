@@ -186,3 +186,28 @@ def test_native_topic_validate_matches_python():
         want_t = topic_valid(t)
         assert rt.topic_validate(t, is_filter=True) == want_f, ("filter", t)
         assert rt.topic_validate(t, is_filter=False) == want_t, ("topic", t)
+
+
+def test_runtime_sanitizers():
+    """ASan+UBSan pass over every native C ABI entry point (runtime/
+    test_runtime.cc via `make sancheck`): leaks/overflows/UB in the C++
+    runtime fail the suite even though Python links the unsanitized .so."""
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    from rmqtt_tpu import runtime as rt
+
+    # rt.available() already proves make + a working C++ compiler (whatever
+    # $CXX is); checking for g++ literally would skip on clang-only hosts
+    if shutil.which("make") is None or not rt.available():
+        import pytest
+
+        pytest.skip("no C++ toolchain")
+    runtime_dir = Path(__file__).resolve().parent.parent / "runtime"
+    r = subprocess.run(
+        ["make", "-s", "sancheck"], cwd=runtime_dir,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, f"sanitizer check failed:\n{r.stdout}\n{r.stderr}"
+    assert "runtime sanitizer checks passed" in r.stdout
