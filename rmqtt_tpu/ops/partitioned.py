@@ -711,27 +711,35 @@ class PartitionedMatcher:
                 break
             # rare: re-run wider; sticky so later batches skip the narrow run
             self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
-        rows = _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b)
-        # physical rows → stable filter ids (rows migrate between chunks)
-        fid_map = self.table._fid_of_row
-        return [np.sort(fid_map[r]) for r in rows]
+        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
 
 
-def _decode_batch(wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int) -> List[np.ndarray]:
-    """Vectorized (word_idx, word_bits) → per-topic matched ROW arrays."""
+def _decode_batch(
+    wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int,
+    fid_map: np.ndarray,
+) -> List[np.ndarray]:
+    """Vectorized (word_idx, word_bits) → per-topic sorted FILTER-ID arrays.
+
+    Physical rows map to stable fids and sort per topic in whole-batch
+    numpy ops (a per-topic Python loop over 16K topics measured
+    ~11µs/topic, capping host throughput)."""
     wpc = WORDS_PER_CHUNK
-    k = wi.shape[1]
-    bitpos = np.unpackbits(
-        np.ascontiguousarray(wb).view(np.uint8).reshape(b * k, 4), axis=1, bitorder="little"
-    ).reshape(b, k, 32)
-    tj, kj, cols = np.nonzero(bitpos)
-    widx = wi[tj, kj]
+    # expand bits only for NONZERO words: scanning the fully-unpacked
+    # [B, K, 32] bool tensor cost ~60ms/16K topics in np.nonzero alone,
+    # while nonzero words are ~2% of the tensor at realistic match rates
+    tjw, kjw = np.nonzero(wb)
+    words = wb[tjw, kjw]
+    bits = (words[:, None] >> np.arange(32, dtype=np.uint32)) & np.uint32(1)
+    nz_i, cols = np.nonzero(bits)
+    tj = tjw[nz_i]
+    widx = wi[tjw, kjw][nz_i]
     rows = (
         chunk_ids[tj, widx // wpc].astype(np.int64) * CHUNK
         + (widx % wpc).astype(np.int64) * 32
         + cols
     )
-    order = np.lexsort((rows, tj))
-    tj, rows = tj[order], rows[order]
+    fids = fid_map[rows]
+    order = np.lexsort((fids, tj))
+    tj, out = tj[order], fids[order]
     bounds = np.searchsorted(tj, np.arange(1, b))
-    return np.split(rows, bounds)
+    return np.split(out, bounds)

@@ -12,6 +12,7 @@
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
+#include "rmqtt_runtime.h"
 #include <cstdint>
 #include <cstring>
 

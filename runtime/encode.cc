@@ -13,6 +13,7 @@
 // Exposed as a C ABI for ctypes (no pybind11 in this image). Thread safety:
 // external, same contract as topics.cc.
 
+#include "rmqtt_runtime.h"
 #include <cstdint>
 #include <cstring>
 #include <string>

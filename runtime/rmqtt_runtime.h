@@ -1,0 +1,38 @@
+// C ABI of the native runtime — included by every implementation file AND
+// the sanitizer test driver so a signature drift is a compile error (with
+// extern "C" linkage a hand-redeclared prototype would still link and call
+// with a mismatched ABI).
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+// topics.cc — topic-trie matcher
+void* rt_trie_new();
+void rt_trie_free(void* trie);
+int rt_trie_add(void* trie, const char* topic_filter, int64_t value);
+int rt_trie_remove(void* trie, const char* topic_filter, int64_t value);
+int64_t rt_trie_size(void* trie);
+int64_t rt_trie_match(void* trie, const char* topic, int64_t* out, int64_t cap);
+int64_t rt_trie_match_batch(void* trie, const char* blob, int64_t n,
+                            int64_t* counts, int64_t* out, int64_t cap);
+
+// encode.cc — batched publish-topic encoder
+void* rt_enc_new();
+void rt_enc_free(void* enc);
+void rt_enc_add_token(void* enc, const char* s, int32_t len, int32_t id);
+void rt_enc_cache_clear(void* enc);
+void rt_enc_cache_put(void* enc, const char* key, int32_t keylen,
+                      const int32_t* chunks, int32_t n);
+int64_t rt_enc_encode(void* enc, const char* blob, int64_t n, int32_t max_levels,
+                      int32_t* ttok, int32_t* tlen, uint8_t* tdollar, int32_t nc_cap,
+                      int32_t* cand, int32_t* cand_counts, int32_t* miss_idx);
+
+// codec.cc — MQTT frame scanner + topic validation
+int64_t rt_codec_scan(const uint8_t* buf, int64_t len, int32_t is_v5,
+                      int64_t max_size, int64_t* meta, int64_t cap,
+                      int64_t* consumed, int32_t* err);
+int rt_topic_validate(const uint8_t* s, int64_t len, int is_filter);
+
+}  // extern "C"

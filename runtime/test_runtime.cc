@@ -13,27 +13,7 @@
 #include <string>
 #include <vector>
 
-extern "C" {
-void* rt_trie_new();
-void rt_trie_free(void*);
-int rt_trie_add(void*, const char*, int64_t);
-int rt_trie_remove(void*, const char*, int64_t);
-int64_t rt_trie_size(void*);
-int64_t rt_trie_match(void*, const char*, int64_t*, int64_t);
-int64_t rt_trie_match_batch(void*, const char*, int64_t, int64_t*, int64_t*, int64_t);
-
-void* rt_enc_new();
-void rt_enc_free(void*);
-void rt_enc_add_token(void*, const char*, int32_t, int32_t);
-void rt_enc_cache_clear(void*);
-void rt_enc_cache_put(void*, const char*, int32_t, const int32_t*, int32_t);
-int64_t rt_enc_encode(void*, const char*, int64_t, int32_t, int32_t*, int32_t*,
-                      uint8_t*, int32_t, int32_t*, int32_t*, int32_t*);
-
-int64_t rt_codec_scan(const uint8_t*, int64_t, int32_t, int64_t, int64_t*,
-                      int64_t, int64_t*, int32_t*);
-int rt_topic_validate(const uint8_t*, int64_t, int);
-}
+#include "rmqtt_runtime.h"
 
 static void test_trie() {
   void* t = rt_trie_new();

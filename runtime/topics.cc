@@ -10,6 +10,7 @@
 // UTF-8, levels split on '/'. Thread safety: external (the Python side holds
 // the GIL around calls; a dedicated mutex would go here for a C++ server).
 
+#include "rmqtt_runtime.h"
 #include <cstdint>
 #include <cstring>
 #include <memory>
