@@ -12,7 +12,7 @@ import enum
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 from rmqtt_tpu.broker.types import Message
 
@@ -94,6 +94,10 @@ class OutInflight:
             return None
         oldest = next(iter(self._entries.values()))
         return max(0.0, oldest.sent_at + self.retry_interval - time.monotonic())
+
+    def entries(self) -> List[OutEntry]:
+        """Snapshot of the current window (offline-inflight hook/persist)."""
+        return list(self._entries.values())
 
     def due(self) -> Iterator[OutEntry]:
         """Entries past their retry deadline (inflight.rs:257)."""

@@ -115,6 +115,17 @@ class Session:
         """Socket gone: schedule will + expiry (session.rs:405-494)."""
         self.connected = False
         self.state = None
+        if len(self.out_inflight) and self.limits.session_expiry > 0 and not kicked:
+            # unacked QoS1/2 carried into the GENUINE offline path only
+            # (hook.rs OfflineInflightMessages; session.rs:277-291): a
+            # takeover transfers the window to the new session instead —
+            # persisting it too would duplicate deliveries after restart
+            inflight_msgs = [e.msg for e in self.out_inflight.entries()]
+            asyncio.get_running_loop().create_task(
+                self.ctx.hooks.fire(
+                    HookType.OFFLINE_INFLIGHT_MESSAGES, self.id, inflight_msgs, None
+                )
+            )
         if self.will is not None and not clean and not kicked:
             delay = float(self.will.properties.get(P.WILL_DELAY_INTERVAL, 0))
             delay = min(delay, self.limits.session_expiry) if self.limits.session_expiry > 0 else 0.0

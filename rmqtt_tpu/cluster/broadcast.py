@@ -16,6 +16,7 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
+from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
 from rmqtt_tpu.broker.types import Message
@@ -297,6 +298,9 @@ class BroadcastCluster:
     # ------------------------------------------------------------ inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
         ctx = self.ctx
+        # cluster-RPC arrival hook (hook.rs GrpcMessageReceived — our RPC
+        # mesh replaces gRPC but keeps the event)
+        await ctx.hooks.fire(HookType.GRPC_MESSAGE_RECEIVED, mtype, _from_node, None)
         if mtype == M.FORWARDS:
             # scatter-gather: deliver local non-shared, reply shared candidates
             msg = M.msg_from_wire(body["msg"])

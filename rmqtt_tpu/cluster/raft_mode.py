@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
+from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.types import HandshakeLockedError, Message
 from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.broadcast import (
@@ -370,7 +371,9 @@ class RaftCluster:
     # -------------------------------------------------------------- inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:
         if mtype in (RAFT_VOTE, RAFT_APPEND, RAFT_PROPOSE, RAFT_SNAP):
+            # raft heartbeats are too hot for a hook dispatch per message
             return await self.raft.on_message(mtype, body)
+        await self.ctx.hooks.fire(HookType.GRPC_MESSAGE_RECEIVED, mtype, _from_node, None)
         if mtype == M.PING:
             return {"pong": True, "leader": self.raft.leader_id, "term": self.raft.term}
         res = await handle_common_message(self.ctx, mtype, body)

@@ -202,3 +202,49 @@ async def test_session_state_transfer_across_nodes(brokers, clusters):
     assert p.payload == b"after-move"
     # node 1 no longer holds a copy
     assert b1.ctx.registry.get("roam-p") is None
+
+
+@cluster_test(2)
+async def test_offline_inflight_and_grpc_hooks_fire(brokers, clusters):
+    """hook.rs OfflineInflightMessages + GrpcMessageReceived: both events
+    must actually fire — on offline transition with an unacked window, and
+    on every cluster RPC arrival."""
+    from rmqtt_tpu.broker.codec import props as P
+    from rmqtt_tpu.broker.hooks import HookType
+
+    b1, b2 = brokers
+    seen = {"grpc": [], "offline_inflight": []}
+
+    async def on_grpc(_ht, args, prev):
+        seen["grpc"].append(args[0])
+        return prev
+
+    async def on_offline_inflight(_ht, args, prev):
+        seen["offline_inflight"].append([m.topic for m in args[1]])
+        return prev
+
+    b2.ctx.hooks.register(HookType.GRPC_MESSAGE_RECEIVED, on_grpc)
+    b1.ctx.hooks.register(HookType.OFFLINE_INFLIGHT_MESSAGES, on_offline_inflight)
+    # cross-node traffic makes RPCs arrive at node 2
+    sub = await TestClient.connect(b2.port, "hooks-sub", version=pk.V5,
+                                   clean_start=False,
+                                   properties={P.SESSION_EXPIRY_INTERVAL: 300})
+    await sub.subscribe("hk/t", qos=1)
+    pub = await TestClient.connect(b1.port, "hooks-pub")
+    await pub.publish("hk/t", b"x", qos=1)
+    await asyncio.sleep(0.3)
+    assert seen["grpc"], "no GrpcMessageReceived events"
+
+    # offline with an unacked QoS1 window on node 1
+    s1 = await TestClient.connect(b1.port, "hooks-off", version=pk.V5,
+                                  clean_start=False,
+                                  properties={P.SESSION_EXPIRY_INTERVAL: 300})
+    await s1.subscribe("hk/off", qos=1)
+    s1.auto_ack = False
+    await pub.publish("hk/off", b"pending", qos=1)
+    await s1.recv()  # delivered but never acked
+    s1.abort()
+    await asyncio.sleep(0.3)
+    assert seen["offline_inflight"] == [["hk/off"]], seen["offline_inflight"]
+    await sub.disconnect_clean()
+    await pub.disconnect_clean()
