@@ -678,7 +678,11 @@ class PartitionedMatcher:
             self._dev_version = t.version
         return self._dev_arrays
 
-    def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
+    def match_submit(self, topics: Sequence[str], pad_to_pow2: bool = True):
+        """Encode + dispatch WITHOUT fetching: jax dispatch is async, so the
+        caller can submit batch N+1 (host encode) while N computes on
+        device, then ``match_complete`` each handle in order. This is how
+        the bench pipelines over a high-latency dispatch path."""
         b = len(topics)
         if pad_to_pow2:
             padded = 1 << (b - 1).bit_length() if b > 1 else b
@@ -699,19 +703,38 @@ class PartitionedMatcher:
         )
         dev = self._refresh()
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
+        if words is not None:
+            wi, wb, cn = _compact_words(words, max_words=self.max_words)
+        else:
+            wi, wb, cn = _match_partitioned(
+                dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
+            )
+        # the handle carries ITS OWN max_words: a sticky widening triggered
+        # by an earlier handle must not let this one pass the overflow check
+        # with results that were truncated at the narrower width
+        return (b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn, self.max_words)
+
+    def match_complete(self, handle) -> List[np.ndarray]:
+        """Block on a ``match_submit`` handle and decode to fid arrays."""
+        b, chunk_ids, words, dev_inputs, wi, wb, cn, kw = handle
         while True:
-            if words is not None:
-                wi, wb, cn = _compact_words(words, max_words=self.max_words)
-            else:
-                wi, wb, cn = _match_partitioned(
-                    dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
-                )
             wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
-            if int(cn[:b].max(initial=0)) <= self.max_words:
+            if int(cn[:b].max(initial=0)) <= kw:
                 break
             # rare: re-run wider; sticky so later batches skip the narrow run
-            self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
+            kw = 1 << (int(cn[:b].max()) - 1).bit_length()
+            self.max_words = max(self.max_words, kw)
+            if words is not None:
+                wi, wb, cn = _compact_words(words, max_words=kw)
+            else:
+                dev, ttok, tlen, tdollar = dev_inputs
+                wi, wb, cn = _match_partitioned(
+                    dev, ttok, tlen, tdollar, chunk_ids, max_words=kw
+                )
         return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
+
+    def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
+        return self.match_complete(self.match_submit(topics, pad_to_pow2))
 
 
 def _decode_batch(

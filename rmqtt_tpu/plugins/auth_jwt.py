@@ -1,11 +1,15 @@
 """JWT authentication plugin.
 
-Mirrors `rmqtt-plugins/rmqtt-auth-jwt`: the client's password carries a JWT;
-HS256/HS384/HS512 are verified with the configured secret (stdlib hmac —
-RSA/ES validation needs an asymmetric-crypto dependency this image doesn't
-ship; gate on config). Claims honored: ``exp`` (reject expired), optional
-``%c``/``%u`` matching claims, ``superuser``, and ``acl`` pub/sub filter
-lists enforced on the ACL hooks.
+Mirrors `rmqtt-plugins/rmqtt-auth-jwt`: the client's password carries a JWT.
+HS256/384/512 verify with the configured shared secret (stdlib hmac);
+RS256/384/512 verify with a configured RSA public key — signature
+VERIFICATION is one modular exponentiation (``pow(sig, e, n)``) plus
+PKCS#1 v1.5 / DigestInfo checking, all stdlib (the public key is given as
+a JWK dict ``{n, e}`` or a PEM SubjectPublicKeyInfo, parsed with a minimal
+DER reader). ES* would need EC point math and stays unimplemented.
+Claims honored: ``exp`` (reject expired), optional ``%c``/``%u`` matching
+claims, ``superuser``, and ``acl`` pub/sub filter lists enforced on the
+ACL hooks.
 """
 
 from __future__ import annotations
@@ -28,19 +32,80 @@ def _b64url_decode(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
-def verify_hs_jwt(token: str, secret: bytes) -> Optional[dict]:
-    """→ claims dict, or None if invalid/expired."""
+_RS_ALGS = {"RS256": hashlib.sha256, "RS384": hashlib.sha384, "RS512": hashlib.sha512}
+
+# DigestInfo DER prefixes for EMSA-PKCS1-v1_5 (RFC 8017 §9.2 notes)
+_DIGEST_INFO = {
+    "RS256": bytes.fromhex("3031300d060960864801650304020105000420"),
+    "RS384": bytes.fromhex("3041300d060960864801650304020205000430"),
+    "RS512": bytes.fromhex("3051300d060960864801650304020305000440"),
+}
+
+
+def _der_read(buf: bytes, pos: int):
+    """→ (tag, content, next_pos) for one DER TLV."""
+    tag = buf[pos]
+    length = buf[pos + 1]
+    pos += 2
+    if length & 0x80:
+        nbytes = length & 0x7F
+        length = int.from_bytes(buf[pos : pos + nbytes], "big")
+        pos += nbytes
+    return tag, buf[pos : pos + length], pos + length
+
+
+def rsa_public_key_from_pem(pem: str):
+    """SubjectPublicKeyInfo PEM → (n, e). Minimal DER walk, stdlib only."""
+    body = "".join(
+        line for line in pem.strip().splitlines() if not line.startswith("-----")
+    )
+    der = base64.b64decode(body)
+    _, spki, _ = _der_read(der, 0)  # SEQUENCE SubjectPublicKeyInfo
+    _, _alg, after_alg = _der_read(spki, 0)  # SEQUENCE AlgorithmIdentifier
+    tag, bitstr, _ = _der_read(spki, after_alg)  # BIT STRING
+    if tag != 0x03:
+        raise ValueError("not a SubjectPublicKeyInfo key")
+    _, rsa_seq, _ = _der_read(bitstr[1:], 0)  # skip unused-bits byte; SEQUENCE
+    _, n_bytes, after_n = _der_read(rsa_seq, 0)  # INTEGER n
+    _, e_bytes, _ = _der_read(rsa_seq, after_n)  # INTEGER e
+    return int.from_bytes(n_bytes, "big"), int.from_bytes(e_bytes, "big")
+
+
+def verify_rs_signature(alg: str, signed: bytes, sig: bytes, n: int, e: int) -> bool:
+    """RSASSA-PKCS1-v1_5 verification: pow + exact EM comparison."""
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    em = pow(int.from_bytes(sig, "big"), e, n).to_bytes(k, "big")
+    digest = _RS_ALGS[alg](signed).digest()
+    t = _DIGEST_INFO[alg] + digest
+    expected = b"\x00\x01" + b"\xff" * (k - len(t) - 3) + b"\x00" + t
+    return hmac.compare_digest(em, expected)
+
+
+def verify_hs_jwt(token: str, secret: bytes, rsa_key=None) -> Optional[dict]:
+    """→ claims dict, or None if invalid/expired. ``rsa_key`` is (n, e) for
+    the RS* algorithms; HS* verify against ``secret``."""
     try:
         head_b64, payload_b64, sig_b64 = token.split(".")
         header = json.loads(_b64url_decode(head_b64))
-        digest = _ALGS.get(header.get("alg", ""))
-        if digest is None:
-            return None
-        expect = hmac.new(secret, f"{head_b64}.{payload_b64}".encode(), digest).digest()
-        if not hmac.compare_digest(expect, _b64url_decode(sig_b64)):
+        alg = header.get("alg", "")
+        signed = f"{head_b64}.{payload_b64}".encode()
+        if alg in _ALGS:
+            if not secret:
+                # RS-only deployments must not accept HS tokens signed with
+                # the empty default secret (algorithm-downgrade bypass)
+                return None
+            expect = hmac.new(secret, signed, _ALGS[alg]).digest()
+            if not hmac.compare_digest(expect, _b64url_decode(sig_b64)):
+                return None
+        elif alg in _RS_ALGS and rsa_key is not None:
+            if not verify_rs_signature(alg, signed, _b64url_decode(sig_b64), *rsa_key):
+                return None
+        else:
             return None
         claims = json.loads(_b64url_decode(payload_b64))
-    except (ValueError, KeyError):
+    except (ValueError, KeyError, IndexError):
         return None
     exp = claims.get("exp")
     if exp is not None and float(exp) <= time.time():
@@ -57,6 +122,16 @@ class AuthJwtPlugin(Plugin):
         secret = self.config.get("secret", "")
         self.secret = secret.encode() if isinstance(secret, str) else bytes(secret)
         self.from_field = self.config.get("from", "password")  # password | username
+        # RS256/384/512: public key as JWK {n, e} (base64url) or PEM string
+        self.rsa_key = None
+        jwk = self.config.get("jwk")
+        if jwk:
+            self.rsa_key = (
+                int.from_bytes(_b64url_decode(jwk["n"]), "big"),
+                int.from_bytes(_b64url_decode(jwk["e"]), "big"),
+            )
+        elif self.config.get("public_key_pem"):
+            self.rsa_key = rsa_public_key_from_pem(self.config["public_key_pem"])
         self._claims: Dict[str, dict] = {}
         self._unhooks = []
 
@@ -72,7 +147,7 @@ class AuthJwtPlugin(Plugin):
             )
             if not token:
                 return None  # not a JWT client; fall through
-            claims = verify_hs_jwt(token, self.secret)
+            claims = verify_hs_jwt(token, self.secret, rsa_key=self.rsa_key)
             if claims is None:
                 return HookResult(proceed=False, value=False)
             # optional identity-claim checks (reference %c/%u placeholders)

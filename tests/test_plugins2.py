@@ -366,3 +366,46 @@ def test_acl_file_plugin():
         await b.stop()
 
     run_async(run)
+
+
+def test_auth_jwt_rs256(tmp_path):
+    """RS256 verification against a token signed by openssl (independent
+    signer): stdlib pow-based RSASSA-PKCS1-v1_5 + DER public-key parse."""
+    import base64
+    import json
+    import subprocess
+
+    from rmqtt_tpu.plugins.auth_jwt import (
+        rsa_public_key_from_pem,
+        verify_hs_jwt,
+    )
+
+    key = tmp_path / "rsa.key"
+    pub = tmp_path / "rsa.pub"
+    subprocess.run(["openssl", "genrsa", "-out", str(key), "2048"],
+                   check=True, capture_output=True)
+    subprocess.run(["openssl", "rsa", "-in", str(key), "-pubout", "-out", str(pub)],
+                   check=True, capture_output=True)
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    header = b64url(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+    payload = b64url(json.dumps({"sub": "dev-1", "superuser": True}).encode())
+    signing_input = f"{header}.{payload}".encode()
+    blob = tmp_path / "in.bin"
+    blob.write_bytes(signing_input)
+    sig = subprocess.run(
+        ["openssl", "dgst", "-sha256", "-sign", str(key), str(blob)],
+        check=True, capture_output=True,
+    ).stdout
+    token = f"{header}.{payload}.{b64url(sig)}"
+
+    rsa_key = rsa_public_key_from_pem(pub.read_text())
+    claims = verify_hs_jwt(token, b"", rsa_key=rsa_key)
+    assert claims == {"sub": "dev-1", "superuser": True}
+    # tampered payload must fail
+    bad = f"{header}.{b64url(json.dumps({'sub': 'evil'}).encode())}.{b64url(sig)}"
+    assert verify_hs_jwt(bad, b"", rsa_key=rsa_key) is None
+    # RS token without a configured key must fail closed
+    assert verify_hs_jwt(token, b"secret", rsa_key=None) is None
