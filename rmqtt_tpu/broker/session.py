@@ -639,12 +639,10 @@ class SessionState:
             return False, RC_TOPIC_NAME_INVALID
         if not topic_valid(topic):
             return False, RC_TOPIC_NAME_INVALID
-        msg = Message.from_publish(p, from_id=s.id)
-        msg = replace(msg, topic=topic, delay_interval=delay_secs)
-        if s.limits.max_message_expiry > 0:
-            cap = s.limits.max_message_expiry
-            if msg.expiry_interval is None or msg.expiry_interval > cap:
-                msg = replace(msg, expiry_interval=cap)
+        msg = Message.from_publish(
+            p, from_id=s.id, topic=topic, delay_interval=delay_secs,
+            expiry_cap=s.limits.max_message_expiry,
+        )
         # hook may transform the message (message_publish, session.rs:1008)
         hooked = await self.ctx.hooks.fire(HookType.MESSAGE_PUBLISH, s.id, msg, initial=msg)
         if hooked is None:

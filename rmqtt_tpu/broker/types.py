@@ -8,7 +8,7 @@ Mirrors the reference's DTO layer (`/root/reference/rmqtt/src/types.rs`):
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
 from rmqtt_tpu.broker.codec import packets as pk
@@ -48,15 +48,29 @@ class Message:
         return max(0, int(left))
 
     @classmethod
-    def from_publish(cls, p: pk.Publish, from_id: Optional[Id] = None) -> "Message":
+    def from_publish(
+        cls,
+        p: pk.Publish,
+        from_id: Optional[Id] = None,
+        topic: Optional[str] = None,
+        delay_interval: Optional[float] = None,
+        expiry_cap: float = 0.0,
+    ) -> "Message":
+        """``topic`` overrides the wire topic ($delayed stripped),
+        ``expiry_cap`` > 0 clamps the expiry — taking these here avoids
+        per-publish dataclasses.replace churn on the hot ingress path."""
         expiry = p.properties.get(P.MESSAGE_EXPIRY_INTERVAL)
+        expiry = float(expiry) if expiry is not None else None
+        if expiry_cap > 0 and (expiry is None or expiry > expiry_cap):
+            expiry = expiry_cap
         return cls(
-            topic=p.topic,
+            topic=p.topic if topic is None else topic,
             payload=p.payload,
             qos=p.qos,
             retain=p.retain,
             properties={k: v for k, v in p.properties.items() if k != P.TOPIC_ALIAS},
-            expiry_interval=float(expiry) if expiry is not None else None,
+            expiry_interval=expiry,
+            delay_interval=delay_interval,
             from_id=from_id,
         )
 
