@@ -585,6 +585,27 @@ _match_partitioned = jax.jit(match_partitioned_impl, static_argnames=("max_words
 _compact_words = jax.jit(compact_words_impl, static_argnames=("max_words",))
 
 
+def pack_device_rows(t: PartitionedTable) -> np.ndarray:
+    """The device mirror of a table: chunk-tiled ``[nchunks, CHUNK, L+3]``
+    rows (tokens + flen + prefix_len + hash|wild flags), active prefix
+    padded to a pow2 chunk count (floor 64) so table growth does not change
+    the array shape on every new chunk — each pow2 bucket costs ONE kernel
+    recompile. Padding rows are zeros (flen=0), rejected for every topic.
+    Single source of the row layout for the local and mesh-sharded paths.
+    """
+    up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
+    rows = t.nchunks * CHUNK
+    lvl = t.max_levels
+    packed = np.zeros((up_chunks * CHUNK, lvl + 3), dtype=np.int32)
+    packed[:rows, :lvl] = t.tok[:rows]
+    packed[:rows, lvl] = t.flen[:rows]
+    packed[:rows, lvl + 1] = t.prefix_len[:rows]
+    packed[:rows, lvl + 2] = t.has_hash[:rows].astype(np.int32) | (
+        t.first_wild[:rows] << 1
+    )
+    return packed.reshape(-1, CHUNK, lvl + 3)
+
+
 class PartitionedMatcher:
     """Device mirror + batched match over a ``PartitionedTable``.
 
@@ -659,22 +680,7 @@ class PartitionedMatcher:
                 if self.device
                 else jax.device_put
             )
-            # upload the active prefix, padded to a pow2 chunk count (floor
-            # 64) so table growth does not change the device-array shape on
-            # every new chunk — each pow2 bucket costs ONE recompile of the
-            # match kernel, not one per chunk. Padding rows are zeros
-            # (flen=0), which the match formula rejects for every topic.
-            up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
-            rows = t.nchunks * CHUNK
-            lvl = t.max_levels
-            packed = np.zeros((up_chunks * CHUNK, lvl + 3), dtype=np.int32)
-            packed[:rows, :lvl] = t.tok[:rows]
-            packed[:rows, lvl] = t.flen[:rows]
-            packed[:rows, lvl + 1] = t.prefix_len[:rows]
-            packed[:rows, lvl + 2] = t.has_hash[:rows].astype(np.int32) | (
-                t.first_wild[:rows] << 1
-            )
-            self._dev_arrays = put(packed.reshape(-1, CHUNK, lvl + 3))
+            self._dev_arrays = put(pack_device_rows(t))
             self._dev_version = t.version
         return self._dev_arrays
 

@@ -108,3 +108,62 @@ class ShardedMatcher:
             sh(tlen, P("dp")),
             sh(tdollar, P("dp")),
         )
+
+
+class ShardedPartitionedMatcher:
+    """The FLAGSHIP (partitioned-automaton) matcher over a device mesh:
+    table replicated, publish batch sharded across every mesh device
+    (raft-analogue data parallelism, router.rs:199-201 — match is local to
+    each device's topic slice, no per-publish collective). The chunk-tiled
+    gather reads the replicated table; per-topic outputs stay sharded until
+    the host pulls the compact words. For tables too large to replicate,
+    the ``fp``-sharded dense path above is the scatter-gather analogue.
+    """
+
+    def __init__(self, table, mesh: Mesh, max_words: int = 32) -> None:
+        self.table = table
+        self.mesh = mesh
+        self.ndev = int(np.prod(list(mesh.shape.values())))
+        self.max_words = max_words
+        self._dev_version = -1
+        self._dev_rows = None
+
+    def _refresh(self):
+        from rmqtt_tpu.ops.partitioned import pack_device_rows
+
+        t = self.table
+        if self._dev_version != t.version or self._dev_rows is None:
+            self._dev_rows = jax.device_put(
+                pack_device_rows(t), NamedSharding(self.mesh, P())  # replicated
+            )
+            self._dev_version = t.version
+        return self._dev_rows
+
+    def match(self, topics) -> list:
+        from rmqtt_tpu.ops.partitioned import _decode_batch, _match_partitioned
+
+        b = len(topics)
+        padded = max(self.ndev, 1 << (b - 1).bit_length() if b > 1 else 1)
+        if padded % self.ndev:
+            padded = self.ndev * ((padded + self.ndev - 1) // self.ndev)
+        ttok, tlen, tdollar, chunk_ids, _nc = self.table.encode_topics(
+            topics, pad_batch_to=padded
+        )
+        dev = self._refresh()
+        batch_spec = NamedSharding(self.mesh, P(("dp", "fp")))
+        row_spec = NamedSharding(self.mesh, P(("dp", "fp"), None))
+        inputs = (
+            jax.device_put(ttok, row_spec),
+            jax.device_put(tlen, batch_spec),
+            jax.device_put(tdollar, batch_spec),
+            jax.device_put(chunk_ids, row_spec),
+        )
+        while True:
+            wi, wb, cn = _match_partitioned(dev, *inputs, max_words=self.max_words)
+            wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
+            if int(cn[:b].max(initial=0)) <= self.max_words:
+                break
+            # rare overflow: re-run only the kernel, wider (inputs stay on
+            # device; no re-encode/re-upload)
+            self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
+        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)

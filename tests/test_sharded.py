@@ -51,3 +51,39 @@ def test_sharded_agrees_with_single(dp, fp):
         expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
         assert rows[j].tolist() == expect
         assert int(counts[j]) == len(expect)
+
+
+def test_sharded_partitioned_matches_oracle():
+    """Flagship partitioned matcher over the 8-device mesh (batch sharded,
+    table replicated) agrees with the single-device matcher and the trie
+    oracle."""
+    import random
+
+    from rmqtt_tpu.core.topic import filter_valid, match_filter
+    from rmqtt_tpu.ops.partitioned import PartitionedMatcher, PartitionedTable
+    from rmqtt_tpu.parallel.sharded import ShardedPartitionedMatcher, make_mesh
+
+    rng = random.Random(77)
+    table = PartitionedTable()
+    fids = {}
+    words = ["a", "b", "c", "d", "", "+"]
+    while len(fids) < 1200:
+        levels = [rng.choice(words) for _ in range(rng.randint(1, 6))]
+        if rng.random() < 0.3:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if filter_valid(f):
+            fids[table.add(f)] = f
+    mesh = make_mesh(dp=2, fp=4)
+    sharded = ShardedPartitionedMatcher(table, mesh)
+    single = PartitionedMatcher(table)
+    topics = [
+        "/".join(rng.choice(["a", "b", "c", "x", ""]) for _ in range(rng.randint(1, 6)))
+        for _ in range(96)
+    ] + ["$sys/x"]
+    got = sharded.match(topics)
+    ref = single.match(topics)
+    for topic, row, srow in zip(topics, ref, got):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert row.tolist() == expect, topic
+        assert srow.tolist() == expect, topic
