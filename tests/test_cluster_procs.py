@@ -45,7 +45,7 @@ def _spawn_node(node_id: int, port: int, cport: int, peers: list) -> subprocess.
     )
 
 
-def _wait_port(port: int, timeout: float = 15.0) -> None:
+def _wait_port(port: int, timeout: float = 45.0) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -72,7 +72,7 @@ def test_three_process_cluster_with_chaos():
         assert ack.reason_codes[0] < 0x80
         pub = await TestClient.connect(mports[1], "proc-pub")
 
-        async def publish_until_delivered(topic, payload, timeout=10.0):
+        async def publish_until_delivered(topic, payload, timeout=30.0):
             """Cross-node route visibility is eventual: retry the publish
             until the subscriber sees it (dedup by payload)."""
             deadline = asyncio.get_running_loop().time() + timeout
@@ -101,7 +101,7 @@ def test_three_process_cluster_with_chaos():
         sub3 = await TestClient.connect(mports[2], "proc-sub3")
         ack = await sub3.subscribe("pc/rejoin/#", qos=1)
         assert ack.reason_codes[0] < 0x80
-        deadline = asyncio.get_running_loop().time() + 15.0
+        deadline = asyncio.get_running_loop().time() + 45.0
         while True:
             await pub.publish("pc/rejoin/x", b"to-newbie", qos=1)
             try:
@@ -128,7 +128,7 @@ def test_three_process_cluster_with_chaos():
             spawn(i)
         for p in mports[:3]:
             _wait_port(p)
-        asyncio.run(asyncio.wait_for(drive(), timeout=90.0))
+        asyncio.run(asyncio.wait_for(drive(), timeout=240.0))
     finally:
         errs = {}
         for i, proc in procs.items():
