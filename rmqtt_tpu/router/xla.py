@@ -36,12 +36,47 @@ class XlaRouter(Router):
         table=None,
         device=None,
         backend: str = "partitioned",
+        mesh="auto",
     ) -> None:
+        """``mesh``: a ``jax.sharding.Mesh`` to data-parallelize the
+        partitioned matcher over (batch sharded, table replicated);
+        ``"auto"`` uses all devices when running on a multi-chip TPU slice
+        (single-device and CPU-test environments keep the local matcher);
+        ``None`` forces single-device."""
+        if mesh not in (None, "auto") and (backend != "partitioned" or device is not None):
+            raise ValueError(
+                "mesh is only supported with backend='partitioned' and no "
+                "explicit device (use parallel.ShardedMatcher for dense)"
+            )
         if backend == "partitioned":
             from rmqtt_tpu.ops.partitioned import PartitionedMatcher, PartitionedTable
 
             self.table = table or PartitionedTable()
-            self.matcher = PartitionedMatcher(self.table, device=device)
+            use_mesh = None if mesh == "auto" else mesh
+            if mesh == "auto" and device is None:
+                try:
+                    # the platform guard MUST run before the first backend
+                    # touch: jax.devices() hangs forever on a wedged
+                    # accelerator grant (tpuprobe; memoized, instant when the
+                    # process already chose a platform)
+                    from rmqtt_tpu.utils.tpuprobe import ensure_safe_platform
+
+                    if ensure_safe_platform() != "cpu":
+                        import jax
+
+                        devs = jax.devices()
+                        if len(devs) > 1 and devs[0].platform == "tpu":
+                            from rmqtt_tpu.parallel.sharded import make_mesh
+
+                            use_mesh = make_mesh(devices=devs, dp=len(devs), fp=1)
+                except Exception:
+                    use_mesh = None
+            if use_mesh is not None:
+                from rmqtt_tpu.parallel.sharded import ShardedPartitionedMatcher
+
+                self.matcher = ShardedPartitionedMatcher(self.table, use_mesh)
+            else:
+                self.matcher = PartitionedMatcher(self.table, device=device)
         elif backend == "dense":
             self.table = table or FilterTable()
             self.matcher = TpuMatcher(self.table, device=device)

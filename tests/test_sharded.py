@@ -87,3 +87,45 @@ def test_sharded_partitioned_matches_oracle():
         expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
         assert row.tolist() == expect, topic
         assert srow.tolist() == expect, topic
+
+
+def test_broker_with_mesh_router():
+    """A full broker whose XlaRouter runs the mesh-sharded partitioned
+    matcher (explicit mesh — the 'auto' gate engages only on multi-chip
+    TPU): pub/sub over real sockets routes through all 8 virtual devices."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.server import MqttBroker
+    from rmqtt_tpu.parallel.sharded import ShardedPartitionedMatcher, make_mesh
+    from rmqtt_tpu.router.xla import XlaRouter
+
+    from tests.mqtt_client import TestClient
+
+    async def run():
+        ctx = ServerContext(BrokerConfig(port=0))
+        router = XlaRouter(
+            is_online=lambda cid: (
+                ctx.registry.get(cid) is not None and ctx.registry.get(cid).connected
+            ),
+            mesh=make_mesh(dp=2, fp=4),
+        )
+        assert isinstance(router.matcher, ShardedPartitionedMatcher)
+        ctx.router = router
+        ctx.routing.router = router
+        b = MqttBroker(ctx)
+        await b.start()
+        try:
+            sub = await TestClient.connect(b.port, "mesh-sub")
+            await sub.subscribe("m/+/t", "m/#", qos=1)
+            pub = await TestClient.connect(b.port, "mesh-pub")
+            await pub.publish("m/a/t", b"via-mesh", qos=1)
+            got = [await sub.recv(timeout=30), await sub.recv(timeout=30)]
+            assert all(p.payload == b"via-mesh" for p in got)
+            await sub.disconnect_clean()
+            await pub.disconnect_clean()
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 120))
