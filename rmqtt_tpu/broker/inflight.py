@@ -8,6 +8,7 @@ timestamps, credit gating (:319 ``has_credit``) and packet-id allocation
 
 from __future__ import annotations
 
+import asyncio
 import enum
 import time
 from collections import OrderedDict
@@ -50,9 +51,23 @@ class OutInflight:
         self.max_retries = max_retries
         self._entries: "OrderedDict[int, OutEntry]" = OrderedDict()
         self._next_pid = 1
+        # event-driven credit: a 10ms sleep-poll in the deliver loop capped
+        # per-session QoS1/2 delivery at ~max_inflight/10ms (measured 1.6K
+        # msg/s at the default window of 16)
+        self._credit_ev = asyncio.Event()
+        self._credit_ev.set()
 
     def has_credit(self) -> bool:
         return len(self._entries) < self.max_inflight
+
+    async def wait_credit(self) -> None:
+        await self._credit_ev.wait()
+
+    def _update_credit(self) -> None:
+        if self.has_credit():
+            self._credit_ev.set()
+        else:
+            self._credit_ev.clear()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -68,13 +83,16 @@ class OutInflight:
 
     def push(self, entry: OutEntry) -> None:
         self._entries[entry.packet_id] = entry
+        self._update_credit()
 
     def get(self, packet_id: int) -> Optional[OutEntry]:
         return self._entries.get(packet_id)
 
     def ack(self, packet_id: int) -> Optional[OutEntry]:
         """PUBACK (QoS1) or PUBCOMP (QoS2 final): remove from window."""
-        return self._entries.pop(packet_id, None)
+        e = self._entries.pop(packet_id, None)
+        self._update_credit()
+        return e
 
     def pubrec(self, packet_id: int) -> Optional[OutEntry]:
         """QoS2 PUBREC: advance to UNCOMPLETE (awaiting PUBCOMP)."""
@@ -112,6 +130,7 @@ class OutInflight:
         e.sent_at = time.monotonic()
         if e.retries > self.max_retries:
             self._entries.pop(e.packet_id, None)
+            self._update_credit()
             return False
         if e.packet_id in self._entries:
             self._entries.move_to_end(e.packet_id)  # keep sent_at ordering
@@ -121,6 +140,7 @@ class OutInflight:
         """Take everything (session takeover transfer, session.rs:1374-1427)."""
         entries = list(self._entries.values())
         self._entries.clear()
+        self._update_credit()
         return iter(entries)
 
 

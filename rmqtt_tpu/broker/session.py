@@ -401,8 +401,10 @@ class SessionState:
             await s.deliver_queue.wait_nonempty()
             await s.deliver_queue.throttle()
             if not s.out_inflight.has_credit():
-                # credit-gated (session.rs:362, inflight.rs:319)
-                await asyncio.sleep(0.01)
+                # credit-gated (session.rs:362, inflight.rs:319): wake on the
+                # ack that frees a slot instead of sleep-polling (which
+                # capped QoS1/2 delivery at ~window/10ms per session)
+                await s.out_inflight.wait_credit()
                 continue
             item = s.deliver_queue.pop()
             if item is None:

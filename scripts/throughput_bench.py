@@ -44,9 +44,9 @@ async def connect(port, cid):
     return reader, writer, codec
 
 
-async def subscribe(conn, tf):
+async def subscribe(conn, tf, qos=0):
     reader, writer, codec = conn
-    writer.write(codec.encode(pk.Subscribe(1, [(tf, pk.SubOpts(qos=0))])))
+    writer.write(codec.encode(pk.Subscribe(1, [(tf, pk.SubOpts(qos=qos))])))
     await writer.drain()
     await _read_until(reader, codec, pk.Suback)
 
@@ -87,6 +87,66 @@ async def scenario_pipe(port, msgs):
     await task
     dt = time.monotonic() - t0
     print(f"1->1 pipe:    {msgs} msgs in {dt:.2f}s = {msgs / dt:,.0f} msg/s")
+
+
+async def scenario_pipe_qos1(port, msgs):
+    """QoS1 pipe: publisher paced by DELIVERIES (stays under the broker's
+    bounded deliver queue, so nothing is policy-dropped) and every hop is
+    acked — the lossless end-to-end figure."""
+    sub = await connect(port, "tp1-sub")
+    reader, writer, codec = sub
+    await subscribe(sub, "tp1/pipe", qos=1)
+    pub = await connect(port, "tp1-pub")
+    pr, pw, pc = pub
+    t0 = time.monotonic()
+    deadline = t0 + 180
+    state = {"sent": 0, "got": 0}
+
+    async def drain_and_ack():
+        while state["got"] < msgs:
+            data = await asyncio.wait_for(reader.read(1 << 16), deadline - time.monotonic())
+            if not data:
+                raise ConnectionError("subscriber closed")
+            acks = bytearray()
+            for p in codec.feed(data):
+                if isinstance(p, pk.Publish):
+                    state["got"] += 1
+                    if p.packet_id is not None:
+                        acks += codec.encode(pk.Puback(p.packet_id))
+            if acks:
+                writer.write(bytes(acks))
+                await writer.drain()
+
+    async def drain_pubacks():
+        while state["got"] < msgs:
+            try:
+                data = await asyncio.wait_for(pr.read(1 << 16), 1.0)
+            except asyncio.TimeoutError:
+                continue
+            pc.feed(data)  # count-free: pacing rides deliveries
+
+    async def sender():
+        while state["sent"] < msgs:
+            if state["sent"] - state["got"] >= 500:  # < broker mqueue (1000)
+                await asyncio.sleep(0.002)
+                continue
+            burst = bytearray()
+            for _ in range(min(64, msgs - state["sent"])):
+                state["sent"] += 1
+                burst += pc.encode(pk.Publish(topic="tp1/pipe", payload=b"x" * 64,
+                                              qos=1, packet_id=(state["sent"] % 65000) + 1))
+            pw.write(bytes(burst))
+            await pw.drain()
+
+    drainer = asyncio.create_task(drain_pubacks())
+    send_task = asyncio.create_task(sender())
+    try:
+        await asyncio.gather(drain_and_ack(), send_task)
+    finally:
+        for t in (drainer, send_task):
+            t.cancel()
+    dt = time.monotonic() - t0
+    print(f"1->1 qos1:    {msgs} delivered+acked msgs in {dt:.2f}s = {msgs / dt:,.0f} msg/s")
 
 
 async def scenario_fanout(port, msgs, nsubs=50):
@@ -144,6 +204,7 @@ async def main():
         else:
             raise RuntimeError("broker never started listening")
         await scenario_pipe(args.port, args.msgs)
+        await scenario_pipe_qos1(args.port, args.msgs)
         await scenario_fanout(args.port, args.msgs)
         await scenario_fanin(args.port, args.msgs)
     finally:
