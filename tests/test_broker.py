@@ -480,3 +480,39 @@ def test_handshake_executor_gate():
             await b.stop()
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_handshake_rate_gate():
+    """max_handshake_rate: connects beyond the configured handshakes/sec are
+    refused before any bytes are read (node.rs:212-239 busy detection)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, max_handshake_rate=2.0)))
+        await b.start()
+        try:
+            ok = await connect(b, "rate-1")
+            assert ok.connack.reason_code == 0
+            # burst: push the 5s-window rate above 2/s. Each connection
+            # sends a CONNECT; a refused one is closed with no CONNACK.
+            from rmqtt_tpu.broker.codec import MqttCodec
+
+            refused = 0
+            for i in range(14):
+                try:
+                    reader, writer = await asyncio.open_connection("127.0.0.1", b.port)
+                    codec = MqttCodec()
+                    writer.write(codec.encode(pk.Connect(client_id=f"rate-b{i}")))
+                    await writer.drain()
+                    data = await asyncio.wait_for(reader.read(64), 5)
+                    if data == b"":
+                        refused += 1
+                    writer.close()
+                except (ConnectionError, asyncio.TimeoutError):
+                    refused += 1
+            assert refused > 0, "rate gate never refused"
+            assert b.ctx.metrics.get("handshake.refused_busy") >= refused
+            await ok.disconnect_clean()
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
