@@ -28,7 +28,7 @@ def test_connection_storm():
     async def run():
         b = MqttBroker(ServerContext(BrokerConfig(port=0)))
         await b.start()
-        n = int(os.environ.get("STRESS_CLIENTS", "150"))
+        n = int(os.environ.get("STRESS_CLIENTS", "500"))
 
         async def one(i):
             c = await TestClient.connect(b.port, f"storm-{i}")
