@@ -87,7 +87,10 @@ class MqttCodec:
             raise self.pending_error
         self._buf += data
         out: List[Packet] = []
-        lib = _native_lib()
+        # the native wrapper costs ~10µs per call (array alloc + ctypes);
+        # it wins on coalesced multi-frame reads, loses on tiny interactive
+        # feeds — only engage above the crossover size
+        lib = _native_lib() if len(self._buf) >= 512 else None
         if lib is not None and self._have_complete_frame():
             # C++ fast path: scan all complete frames at once, PUBLISH
             # pre-parsed (runtime/codec.cc). Stops at CONNECT/incomplete;

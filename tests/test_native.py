@@ -153,7 +153,7 @@ def test_native_codec_scan_matches_python_decoder():
         pos = 0
         saved = codec_mod._native
         while pos < len(stream):
-            n = rng.randint(1, 301)
+            n = rng.randint(1, 2500)
             chunk = stream[pos : pos + n]
             pos += n
             got_fast.extend(fast.feed(chunk))
