@@ -46,6 +46,10 @@ _PROTO_NAMES = {b"MQIsdp": pk.V31, b"MQTT": None}  # None → level byte decides
 
 # native frame scanner (runtime/codec.cc): None = not probed, False = absent
 _native = None
+# per-call crossover: below this buffered size the scan wrapper's ~10µs
+# (array alloc + ctypes marshalling) outweighs the Python decode it saves.
+# tests derive their chunk sizes from this so native coverage survives tuning
+NATIVE_MIN_BYTES = 512
 
 
 def _native_lib():
@@ -90,7 +94,7 @@ class MqttCodec:
         # the native wrapper costs ~10µs per call (array alloc + ctypes);
         # it wins on coalesced multi-frame reads, loses on tiny interactive
         # feeds — only engage above the crossover size
-        lib = _native_lib() if len(self._buf) >= 512 else None
+        lib = _native_lib() if len(self._buf) >= NATIVE_MIN_BYTES else None
         if lib is not None and self._have_complete_frame():
             # C++ fast path: scan all complete frames at once, PUBLISH
             # pre-parsed (runtime/codec.cc). Stops at CONNECT/incomplete;

@@ -153,7 +153,8 @@ def test_native_codec_scan_matches_python_decoder():
         pos = 0
         saved = codec_mod._native
         while pos < len(stream):
-            n = rng.randint(1, 2500)
+            # straddle the native crossover so BOTH paths stay covered
+            n = rng.randint(1, codec_mod.NATIVE_MIN_BYTES * 5)
             chunk = stream[pos : pos + n]
             pos += n
             got_fast.extend(fast.feed(chunk))
