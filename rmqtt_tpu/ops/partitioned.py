@@ -768,7 +768,17 @@ def _decode_batch(
         + cols
     )
     fids = fid_map[rows]
-    order = np.lexsort((fids, tj))
-    tj, out = tj[order], fids[order]
-    bounds = np.searchsorted(tj, np.arange(1, b))
+    # one composite-key sort beats a two-key lexsort (~2x on 200K matches):
+    # topic index in the high bits, fid in the low 32. The pack requires
+    # 0 <= fid < 2^32 — a -1 (cleared-row sentinel, would mean a kernel or
+    # compaction bug) or a fid past 2^32 (4.3 billion add() calls) must
+    # fail loudly, not silently corrupt cross-topic attribution
+    if fids.size and (int(fids.min()) < 0 or int(fids.max()) >= 1 << 32):
+        raise AssertionError(
+            f"fid out of composite-key range: min={fids.min()} max={fids.max()}"
+        )
+    composite = np.sort((tj.astype(np.int64) << 32) | fids)
+    tj_sorted = composite >> 32
+    out = composite & np.int64(0xFFFFFFFF)
+    bounds = np.searchsorted(tj_sorted, np.arange(1, b))
     return np.split(out, bounds)
