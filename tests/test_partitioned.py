@@ -262,3 +262,36 @@ def test_pallas_kernel_interpret_matches_lax():
             del os.environ["RMQTT_PALLAS"]
         else:
             os.environ["RMQTT_PALLAS"] = prior
+
+
+def test_native_decode_matches_numpy():
+    """rt_match_decode (C++) vs the numpy decode oracle on random compact
+    words — byte-for-byte identical per-topic sorted fid lists."""
+    import numpy as np
+
+    from rmqtt_tpu import runtime as rt
+    from rmqtt_tpu.ops.partitioned import (
+        CHUNK,
+        WORDS_PER_CHUNK,
+        _native_decode,
+        _numpy_decode,
+    )
+
+    if rt.load() is None:
+        import pytest
+
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(13)
+    b, k, nc, nchunks = 64, 8, 4, 16
+    wi = rng.integers(0, nc * WORDS_PER_CHUNK, size=(b, k)).astype(np.int32)
+    # sparse random words, some rows empty
+    wb = (rng.integers(0, 1 << 32, size=(b, k), dtype=np.uint32)
+          * (rng.random((b, k)) < 0.3)).astype(np.uint32)
+    chunk_ids = rng.integers(0, nchunks, size=(b, nc)).astype(np.int32)
+    fid_map = rng.integers(0, 1 << 31, size=nchunks * CHUNK).astype(np.int64)
+    got = _native_decode(wi, wb, chunk_ids, b, fid_map)
+    assert got is not None
+    want = _numpy_decode(wi, wb, chunk_ids, b, fid_map)
+    assert len(got) == len(want) == b
+    for g, w in zip(got, want):
+        assert g.tolist() == w.tolist()
