@@ -68,19 +68,24 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
             prefix_ok = jnp.all(eq | plus | beyond, axis=1)  # [CHUNK]
             hh = (flags & 1) != 0
             fw = (flags & 2) != 0
-            tl = tlen_ref[t]
+            tl = tlen_ref[t, 0]
             len_ok = jnp.where(hh, tl >= plen, tl == flen)
-            dollar_ok = jnp.logical_not((tdollar_ref[t] != 0) & fw)
+            dollar_ok = jnp.logical_not((tdollar_ref[t, 0] != 0) & fw)
             m = prefix_ok & len_ok & dollar_ok
+            # Mosaic has no unsigned reductions: pack bits via an int32 sum
+            # (distinct powers of two -> wrap-exact two's complement) and
+            # bitcast the packed words to uint32
             bit = jnp.left_shift(
-                jnp.uint32(1),
-                lax.broadcasted_iota(jnp.uint32, (wpc, 32), 1),
+                jnp.int32(1),
+                lax.broadcasted_iota(jnp.int32, (wpc, 32), 1),
             )
             words = jnp.sum(
-                m.reshape(wpc, 32).astype(jnp.uint32) * bit, axis=1,
-                dtype=jnp.uint32,
+                m.reshape(wpc, 32).astype(jnp.int32) * bit, axis=1,
+                dtype=jnp.int32,
             )
-            out_ref[pl.ds(t, 1), pl.ds(k * wpc, wpc)] = words.reshape(1, wpc)
+            out_ref[pl.ds(t, 1), pl.ds(k * wpc, wpc)] = lax.bitcast_convert_type(
+                words.reshape(1, wpc), jnp.uint32
+            )
 
         lax.fori_loop(0, total, step, None)
 
@@ -105,12 +110,20 @@ def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
         grid=(b // BT,),
         in_specs=[
             pl.BlockSpec((BT, lvl), lambda i: (i, 0)),
-            pl.BlockSpec((BT,), lambda i: (i,)),
-            pl.BlockSpec((BT,), lambda i: (i,)),
+            # rank-1 blocked arrays need 128-multiple blocks on TPU; carry
+            # the per-topic scalars as [B, 1] columns instead
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
             pl.BlockSpec((BT, nc), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),  # packed_rows stays in HBM
         ],
         out_specs=pl.BlockSpec((BT, nc * wpc), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nc * wpc), jnp.uint32),
         interpret=interpret,
-    )(ttok, tlen.astype(jnp.int32), tdollar.astype(jnp.int32), chunk_ids, packed_rows)
+    )(
+        ttok,
+        tlen.astype(jnp.int32).reshape(b, 1),
+        tdollar.astype(jnp.int32).reshape(b, 1),
+        chunk_ids,
+        packed_rows,
+    )

@@ -1,0 +1,150 @@
+"""Per-stage TPU profiling of the partitioned matcher.
+
+Answers, on the real chip, where a match batch's wall-clock goes:
+host encode | device dispatch+compute (counts-only fetch) | device->host
+transfer of the compact words | host decode — plus raw tunnel bandwidth
+and dispatch RTT, then a throughput sweep over (batch, pipeline depth,
+max_words). This is the measurement NOTES.md's north-star projection
+needs confirmed (the projection was built from round-1 constants while
+the chip was unreachable).
+
+Usage:  python scripts/tpu_profile.py [--subs 1000000] [--rounds 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo root (bench helpers)
+
+
+def timed(fn, n=1):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    return (time.perf_counter() - t0) / n, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subs", type=int, default=1_000_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--skip-sweep", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"platform: {dev.platform} ({dev})")
+
+    # ---- raw tunnel characteristics -----------------------------------
+    x = np.zeros((1 << 20,), np.uint32)  # 4 MiB
+    up, d = timed(lambda: jax.device_put(x).block_until_ready())
+    add1 = jax.jit(lambda a: a + 1)
+    np.asarray(add1(d))  # compile
+    down, _ = timed(lambda: np.asarray(add1(d)), n=3)
+    tiny = jax.jit(lambda a: a.sum())
+    float(tiny(d))
+    rtt, _ = timed(lambda: float(tiny(d)), n=10)
+    print(f"upload 4MiB {up * 1e3:.1f}ms ({4 / up:.1f} MiB/s) | "
+          f"download 4MiB {down * 1e3:.1f}ms ({4 / down:.1f} MiB/s) | "
+          f"tiny-rtt {rtt * 1e3:.1f}ms")
+
+    # ---- cfg3-shape table ---------------------------------------------
+    from bench import gen_mixed, gen_topics_uniform  # noqa: E402
+    from rmqtt_tpu.core.topic import parse_shared
+    from rmqtt_tpu.ops.partitioned import (
+        PartitionedMatcher, PartitionedTable, _match_partitioned, _decode_batch,
+    )
+
+    rng = random.Random(args.seed)
+    filters = gen_mixed(rng, args.subs)
+    max_sweep_b = 65536  # largest sweep batch below: pool must cover 4 rounds
+    topics = gen_topics_uniform(rng, max(args.batch * 4, max_sweep_b * 4))
+    t0 = time.perf_counter()
+    table = PartitionedTable()
+    for f in filters:
+        _, stripped = parse_shared(f)
+        table.add(stripped)
+    print(f"table: {args.subs} filters in {time.perf_counter() - t0:.1f}s, "
+          f"nchunks={table.nchunks}")
+
+    matcher = PartitionedMatcher(table)
+    b = args.batch
+    batch = topics[:b]
+
+    # warm (compile + sticky NC/max_words settle)
+    for _ in range(2):
+        matcher.match(batch)
+    print(f"after warmup: max_words={matcher.max_words}, nc_cap={table._nc_cap}, "
+          f"pallas={matcher._pallas}")
+
+    # ---- stage timings -------------------------------------------------
+    enc_t, enc = timed(lambda: table.encode_topics(batch, pad_batch_to=b), n=3)
+    ttok, tlen, tdollar, chunk_ids, nc = enc
+    dev_rows = matcher._refresh()
+
+    kw = matcher.max_words
+
+    def run_counts():
+        wi, wb, cn = _match_partitioned(dev_rows, ttok, tlen, tdollar,
+                                        chunk_ids, max_words=kw)
+        return int(np.asarray(cn).max())
+
+    cnt_t, mx = timed(run_counts, n=args.rounds)
+
+    def run_full():
+        wi, wb, cn = _match_partitioned(dev_rows, ttok, tlen, tdollar,
+                                        chunk_ids, max_words=kw)
+        return np.asarray(wi), np.asarray(wb), np.asarray(cn)
+
+    full_t, (wi, wb, cn) = timed(run_full, n=args.rounds)
+    dec_t, rows = timed(lambda: _decode_batch(wi, wb, chunk_ids, b,
+                                              table._fid_of_row), n=args.rounds)
+    nbytes = wi.nbytes + wb.nbytes + cn.nbytes
+    print(f"B={b} NC={nc} kw={kw} max_count={mx}")
+    print(f"encode      {enc_t * 1e3:8.1f} ms")
+    print(f"disp+compute{cnt_t * 1e3:8.1f} ms (counts-only fetch)")
+    print(f"full fetch  {full_t * 1e3:8.1f} ms (+{(full_t - cnt_t) * 1e3:.1f} ms "
+          f"transfer of {nbytes / 1e6:.2f} MB -> {nbytes / 1e6 / max(full_t - cnt_t, 1e-9):.1f} MB/s)")
+    print(f"decode      {dec_t * 1e3:8.1f} ms  (routes in batch: "
+          f"{sum(len(r) for r in rows)})")
+
+    if args.skip_sweep:
+        return
+
+    # ---- throughput sweep ---------------------------------------------
+    from collections import deque
+
+    for bb in (4096, 16384, 65536):
+        pool = topics[: bb * 4]
+        for depth in (1, 2, 3, 4):
+            m = PartitionedMatcher(table)
+            m.match(pool[:bb])  # warm/settle
+            m.match(pool[:bb])
+            pending = deque()
+            done = 0
+            t0 = time.perf_counter()
+            for r in range(args.rounds):
+                sl = pool[(r % 4) * bb : (r % 4) * bb + bb]
+                pending.append(m.match_submit(sl))
+                if len(pending) >= depth:
+                    m.match_complete(pending.popleft())
+                    done += bb
+            while pending:
+                m.match_complete(pending.popleft())
+                done += bb
+            dt = time.perf_counter() - t0
+            print(f"sweep B={bb:6d} depth={depth} kw={m.max_words:3d}: "
+                  f"{done / dt:10.0f} topics/s ({dt / args.rounds * 1e3:.0f} ms/batch)")
+
+
+if __name__ == "__main__":
+    main()
