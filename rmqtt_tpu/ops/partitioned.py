@@ -563,6 +563,50 @@ def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
     return jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
 
 
+def compact_global_impl(words, budget: int):
+    """Packed words [B, W] → batch-global nonzero compaction.
+
+    Per-topic ``top_k`` (below) must fetch ``max_words`` slots for EVERY
+    topic to cover the worst one — measured 32 slots against a batch
+    average of ~6 nonzero words at 1M subs, so >80% of the device→host
+    transfer (the tunnel-measured wall, scripts/tpu_profile.py) is padding.
+    Here the whole batch shares one ``budget`` of slots: an exclusive
+    prefix sum over the nonzero mask assigns each nonzero word a slot, and
+    a disjoint scatter packs (flat word key, bits) arrays. Keys are flat
+    ``b*W + w`` indices, ascending (topic-major) by construction, so the
+    decoder needs no sort by topic. Overflow (total > budget) drops
+    entries on-device; the caller re-runs with a wider sticky budget.
+
+    → (keys [budget] uint32, bits [budget] uint32, total int32)
+    """
+    b, w = words.shape
+    flat = words.ravel()
+    nz = flat != jnp.uint32(0)
+    nzi = nz.astype(jnp.int32)
+    pos = jnp.cumsum(nzi) - nzi  # exclusive prefix sum
+    total = pos[-1] + nzi[-1]
+    # non-nz (and overflow) slots land at index==budget → dropped. The
+    # sentinel index is duplicated across every zero word, so this scatter
+    # must NOT claim unique_indices (implementation-defined corruption on
+    # backends that exploit the flag before dropping OOB updates).
+    idx = jnp.where(nz & (pos < budget), pos, budget)
+    keys = jnp.zeros((budget,), jnp.uint32).at[idx].set(
+        jnp.arange(b * w, dtype=jnp.uint32), mode="drop"
+    )
+    bits = jnp.zeros((budget,), jnp.uint32).at[idx].set(flat, mode="drop")
+    return keys, bits, total
+
+
+def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int):
+    """Gather-based partitioned match → global-compact (keys, bits, total)."""
+    words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
+    return compact_global_impl(words, budget)
+
+
+_match_global = jax.jit(match_global_impl, static_argnames=("budget",))
+_compact_global = jax.jit(compact_global_impl, static_argnames=("budget",))
+
+
 def compact_words_impl(words, max_words: int):
     """Packed words → (word_idx, word_bits, counts) compaction (shared by
     the lax and Pallas word producers)."""
@@ -616,10 +660,18 @@ class PartitionedMatcher:
     unverified kernel.
     """
 
-    def __init__(self, table: PartitionedTable, device=None, max_words: int = 32) -> None:
+    def __init__(self, table: PartitionedTable, device=None, max_words: int = 32,
+                 compact: Optional[str] = None) -> None:
+        import os
+
         self.table = table
         self.device = device
         self.max_words = max_words
+        # 'global' = batch-global nonzero compaction (one shared slot budget,
+        # ~4x less device→host transfer than per-topic top_k at measured
+        # match rates); 'topk' = per-topic fixed-width slots
+        self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
+        self._budget = 0  # sticky pow2 slot budget for 'global' mode
         self._dev_version = -1
         self._dev_arrays = None
         self._pallas: Optional[bool] = None  # None = not decided yet
@@ -709,20 +761,36 @@ class PartitionedMatcher:
         )
         dev = self._refresh()
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
-        if words is not None:
-            wi, wb, cn = _compact_words(words, max_words=self.max_words)
-        else:
-            wi, wb, cn = _match_partitioned(
+        if self.compact_mode == "global":
+            if not self._budget:
+                self._budget = max(4096, 1 << (4 * padded - 1).bit_length())
+            g = self._budget
+            if words is not None:
+                keys, bits, total = _compact_global(words, budget=g)
+            else:
+                keys, bits, total = _match_global(
+                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                )
+            # the handle carries ITS OWN budget: a sticky widening by a later
+            # handle must not mask this one's truncation
+            return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar),
+                    keys, bits, total, g)
+        wi, wb, cn = (
+            _compact_words(words, max_words=self.max_words)
+            if words is not None
+            else _match_partitioned(
                 dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
             )
-        # the handle carries ITS OWN max_words: a sticky widening triggered
-        # by an earlier handle must not let this one pass the overflow check
-        # with results that were truncated at the narrower width
-        return (b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn, self.max_words)
+        )
+        # same contract: the handle carries ITS OWN max_words
+        return ("k", b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn,
+                self.max_words)
 
     def match_complete(self, handle) -> List[np.ndarray]:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
-        b, chunk_ids, words, dev_inputs, wi, wb, cn, kw = handle
+        if handle[0] == "g":
+            return self._complete_global(handle)
+        _tag, b, chunk_ids, words, dev_inputs, wi, wb, cn, kw = handle
         while True:
             wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
             if int(cn[:b].max(initial=0)) <= kw:
@@ -738,6 +806,25 @@ class PartitionedMatcher:
                     dev, ttok, tlen, tdollar, chunk_ids, max_words=kw
                 )
         return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
+
+    def _complete_global(self, handle) -> List[np.ndarray]:
+        _tag, b, chunk_ids, words, dev_inputs, keys, bits, total, g = handle
+        while True:
+            n = int(total)  # total is exact even when the scatter truncated
+            if n <= g:
+                break
+            g = 1 << max(12, (n - 1).bit_length())
+            self._budget = max(self._budget, g)  # sticky pow2 regrow
+            if words is not None:
+                keys, bits, total = _compact_global(words, budget=g)
+            else:
+                dev, ttok, tlen, tdollar = dev_inputs
+                keys, bits, total = _match_global(
+                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                )
+        keys = np.asarray(keys)[:n]
+        bits = np.asarray(bits)[:n]
+        return _decode_flat(keys, bits, chunk_ids, b, self.table._fid_of_row)
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         return self.match_complete(self.match_submit(topics, pad_to_pow2))
@@ -777,6 +864,77 @@ def _native_decode(wi, wb, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
     return np.split(flat, bounds)
 
 
+def _decode_flat(
+    keys: np.ndarray, bits: np.ndarray, chunk_ids: np.ndarray, b: int,
+    fid_map: np.ndarray,
+) -> List[np.ndarray]:
+    """Global-compaction (keys, bits) → per-topic sorted fid arrays.
+
+    ``keys`` are flat ``t*W + w`` word indices, ascending (topic-major) by
+    the prefix-sum construction. Native path in runtime/encode.cc
+    (rt_match_decode_flat); numpy fallback doubles as its oracle."""
+    native = _native_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    if native is not None:
+        return native
+    return _numpy_decode_flat(keys, bits, chunk_ids, b, fid_map)
+
+
+def _native_decode_flat(keys, bits, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
+    try:
+        from rmqtt_tpu import runtime as rt
+    except Exception:
+        return None
+    res = rt.match_decode_flat(
+        np.ascontiguousarray(keys, dtype=np.uint32),
+        np.ascontiguousarray(bits, dtype=np.uint32),
+        np.ascontiguousarray(chunk_ids, dtype=np.int32),
+        b, WORDS_PER_CHUNK, CHUNK, fid_map,
+    )
+    if res is None:
+        return None
+    flat, counts = res
+    bounds = np.cumsum(counts[:-1])
+    return np.split(flat, bounds)
+
+
+def _numpy_decode_flat(
+    keys: np.ndarray, bits: np.ndarray, chunk_ids: np.ndarray, b: int,
+    fid_map: np.ndarray,
+) -> List[np.ndarray]:
+    wpc = WORDS_PER_CHUNK
+    w_total = chunk_ids.shape[1] * wpc
+    bitpos = (bits[:, None] >> np.arange(32, dtype=np.uint32)) & np.uint32(1)
+    nz_i, cols = np.nonzero(bitpos)
+    key = keys[nz_i]
+    tj = (key // w_total).astype(np.int64)
+    widx = (key % w_total).astype(np.int64)
+    rows = (
+        chunk_ids[tj, widx // wpc].astype(np.int64) * CHUNK
+        + (widx % wpc) * 32
+        + cols
+    )
+    fids = fid_map[rows]
+    return _group_sorted(tj, fids, b)
+
+
+def _group_sorted(tj: np.ndarray, fids: np.ndarray, b: int) -> List[np.ndarray]:
+    """(topic index, fid) pairs → per-topic sorted fid arrays via one
+    composite-key sort (shared tail of both numpy decode oracles).
+
+    The pack requires 0 <= fid < 2^32 — a -1 (cleared-row sentinel, would
+    mean a kernel or compaction bug) or a fid past 2^32 (4.3 billion add()
+    calls) must fail loudly, not silently corrupt cross-topic attribution."""
+    if fids.size and (int(fids.min()) < 0 or int(fids.max()) >= 1 << 32):
+        raise AssertionError(
+            f"fid out of composite-key range: min={fids.min()} max={fids.max()}"
+        )
+    composite = np.sort((tj.astype(np.int64) << 32) | fids)
+    tj_sorted = composite >> 32
+    out = composite & np.int64(0xFFFFFFFF)
+    bounds = np.searchsorted(tj_sorted, np.arange(1, b))
+    return np.split(out, bounds)
+
+
 def _numpy_decode(
     wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int,
     fid_map: np.ndarray,
@@ -798,17 +956,5 @@ def _numpy_decode(
         + cols
     )
     fids = fid_map[rows]
-    # one composite-key sort beats a two-key lexsort (~2x on 200K matches):
-    # topic index in the high bits, fid in the low 32. The pack requires
-    # 0 <= fid < 2^32 — a -1 (cleared-row sentinel, would mean a kernel or
-    # compaction bug) or a fid past 2^32 (4.3 billion add() calls) must
-    # fail loudly, not silently corrupt cross-topic attribution
-    if fids.size and (int(fids.min()) < 0 or int(fids.max()) >= 1 << 32):
-        raise AssertionError(
-            f"fid out of composite-key range: min={fids.min()} max={fids.max()}"
-        )
-    composite = np.sort((tj.astype(np.int64) << 32) | fids)
-    tj_sorted = composite >> 32
-    out = composite & np.int64(0xFFFFFFFF)
-    bounds = np.searchsorted(tj_sorted, np.arange(1, b))
-    return np.split(out, bounds)
+    # one composite-key sort beats a two-key lexsort (~2x on 200K matches)
+    return _group_sorted(tj, fids, b)

@@ -186,3 +186,51 @@ int64_t rt_match_decode(const int32_t* wi, const uint32_t* wb, int64_t b,
   }
   return total;
 }
+
+// Decode the batch-GLOBAL compaction (ops/partitioned.py
+// compact_global_impl): n (key, bits) entries, keys = flat t*W + w word
+// indices ascending (topic-major by the device prefix-sum), W = nc*wpc.
+// Same two-pass contract as rt_match_decode: counts[b] always filled,
+// fids written only when total fits cap; -1 on a bad fid.
+int64_t rt_match_decode_flat(const uint32_t* keys, const uint32_t* bits,
+                             int64_t n, const int32_t* chunk_ids, int64_t b,
+                             int64_t nc, int32_t wpc, int32_t chunk,
+                             const int64_t* fid_map, int64_t* out_fids,
+                             int64_t cap, int64_t* counts) {
+  const int64_t w_total = nc * wpc;
+  for (int64_t t = 0; t < b; ++t) counts[t] = 0;
+  int64_t total = 0;
+  for (int64_t e = 0; e < n; ++e) {
+    const int64_t t = keys[e] / w_total;
+    if (t >= b) return -1;  // key out of range: device/compaction bug
+    const int64_t c = __builtin_popcount(bits[e]);
+    counts[t] += c;
+    total += c;
+  }
+  if (total > cap) return total;
+  int64_t off = 0;
+  int64_t e = 0;
+  for (int64_t t = 0; t < b && e < n; ++t) {
+    if (counts[t] == 0) continue;
+    int64_t* span = out_fids + off;
+    int64_t w = 0;
+    const int32_t* crow = chunk_ids + t * nc;
+    while (e < n && static_cast<int64_t>(keys[e]) / w_total == t) {
+      const int64_t widx = keys[e] % w_total;
+      const int64_t base =
+          static_cast<int64_t>(crow[widx / wpc]) * chunk + (widx % wpc) * 32;
+      uint32_t bb = bits[e];
+      while (bb) {
+        const int bit = __builtin_ctz(bb);
+        bb &= bb - 1;
+        const int64_t fid = fid_map[base + bit];
+        if (fid < 0 || fid >= (1LL << 32)) return -1;
+        span[w++] = fid;
+      }
+      ++e;
+    }
+    std::sort(span, span + w);
+    off += w;
+  }
+  return total;
+}

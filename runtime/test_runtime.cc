@@ -100,6 +100,40 @@ static void test_match_decode() {
   assert(total == -1);
 }
 
+static void test_match_decode_flat() {
+  // batch-global entries, b=2, nc=2, wpc=4 (W=8), chunk=128
+  // topic 0: word 0 (chunk 1, bits 0,1) + word 5 (chunk 2, +32+31)
+  // topic 1: word 8+1 (chunk 2, +32)
+  uint32_t keys[3] = {0, 5, 9};
+  uint32_t bits[3] = {0x3u, 0x80000000u, 0x1u};
+  int32_t chunk_ids[4] = {1, 2, 2, 0};
+  std::vector<int64_t> fid_map(3 * 128);
+  for (size_t i = 0; i < fid_map.size(); ++i) fid_map[i] = 1000 + (int64_t)i;
+  int64_t out[16];
+  int64_t counts[2];
+  int64_t total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
+                                       fid_map.data(), out, 16, counts);
+  assert(total == 4 && counts[0] == 3 && counts[1] == 1);
+  assert(out[0] == 1000 + 128 && out[1] == 1000 + 129);
+  assert(out[2] == 1000 + 2 * 128 + 32 + 31);
+  assert(out[3] == 1000 + 2 * 128 + 32);
+  // overflow: counts filled, nothing written past cap
+  int64_t tiny[1];
+  total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
+                               fid_map.data(), tiny, 1, counts);
+  assert(total == 4 && counts[0] == 3);
+  // out-of-range key (topic index >= b) fails loudly
+  uint32_t bad_keys[1] = {16};  // t = 16/8 = 2 >= b=2
+  total = rt_match_decode_flat(bad_keys, bits, 1, chunk_ids, 2, 2, 4, 128,
+                               fid_map.data(), out, 16, counts);
+  assert(total == -1);
+  // cleared-row sentinel fails loudly
+  fid_map[128] = -1;
+  total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
+                               fid_map.data(), out, 16, counts);
+  assert(total == -1);
+}
+
 static void test_codec() {
   // a CONNACK (2 bytes) + a v5 PUBLISH qos1 with empty props + trailing junk
   std::vector<uint8_t> buf = {
@@ -141,6 +175,7 @@ int main() {
   test_trie();
   test_encoder();
   test_match_decode();
+  test_match_decode_flat();
   test_codec();
   std::puts("runtime sanitizer checks passed");
   return 0;

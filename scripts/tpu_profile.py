@@ -37,9 +37,16 @@ def main():
     ap.add_argument("--rounds", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--cpu", action="store_true", help="force CPU (sanity runs)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu:
+        from jax.extend import backend as _eb
+
+        _eb.clear_backends()  # sitecustomize preselects the axon platform
+        jax.config.update("jax_platforms", "cpu")
 
     dev = jax.devices()[0]
     print(f"platform: {dev.platform} ({dev})")
@@ -76,7 +83,7 @@ def main():
     print(f"table: {args.subs} filters in {time.perf_counter() - t0:.1f}s, "
           f"nchunks={table.nchunks}")
 
-    matcher = PartitionedMatcher(table)
+    matcher = PartitionedMatcher(table, compact="topk")
     b = args.batch
     batch = topics[:b]
 
@@ -86,7 +93,7 @@ def main():
     print(f"after warmup: max_words={matcher.max_words}, nc_cap={table._nc_cap}, "
           f"pallas={matcher._pallas}")
 
-    # ---- stage timings -------------------------------------------------
+    # ---- stage timings (topk path) ------------------------------------
     enc_t, enc = timed(lambda: table.encode_topics(batch, pad_batch_to=b), n=3)
     ttok, tlen, tdollar, chunk_ids, nc = enc
     dev_rows = matcher._refresh()
@@ -117,33 +124,58 @@ def main():
     print(f"decode      {dec_t * 1e3:8.1f} ms  (routes in batch: "
           f"{sum(len(r) for r in rows)})")
 
+    # ---- stage timings (global compaction) ----------------------------
+    mg = PartitionedMatcher(table, compact="global")
+    mg.match(batch)
+    mg.match(batch)
+    g = mg._budget
+
+    def run_global():
+        h = mg.match_submit(batch, pad_to_pow2=False)
+        (_tag, _b, _cids, _words, _devin, keys, bits, total, budget) = h
+        n = int(total)
+        assert n <= budget, f"budget overflow mid-profile ({n} > {budget})"
+        return np.asarray(keys), np.asarray(bits), n
+
+    gfull_t, (keys, bits, total) = timed(run_global, n=args.rounds)
+    from rmqtt_tpu.ops.partitioned import _decode_flat
+
+    gdec_t, grows = timed(lambda: _decode_flat(keys[:total], bits[:total],
+                                               chunk_ids, b,
+                                               table._fid_of_row), n=args.rounds)
+    gbytes = keys.nbytes + bits.nbytes
+    print(f"global: budget={g} total={total} fetch {gfull_t * 1e3:.1f} ms "
+          f"({gbytes / 1e6:.2f} MB) decode {gdec_t * 1e3:.1f} ms "
+          f"(routes: {sum(len(r) for r in grows)})")
+
     if args.skip_sweep:
         return
 
     # ---- throughput sweep ---------------------------------------------
     from collections import deque
 
-    for bb in (4096, 16384, 65536):
-        pool = topics[: bb * 4]
-        for depth in (1, 2, 3, 4):
-            m = PartitionedMatcher(table)
-            m.match(pool[:bb])  # warm/settle
-            m.match(pool[:bb])
-            pending = deque()
-            done = 0
-            t0 = time.perf_counter()
-            for r in range(args.rounds):
-                sl = pool[(r % 4) * bb : (r % 4) * bb + bb]
-                pending.append(m.match_submit(sl))
-                if len(pending) >= depth:
+    for mode in ("global", "topk"):
+        for bb in (4096, 16384, 65536):
+            pool = topics[: bb * 4]
+            for depth in (1, 2, 3):
+                m = PartitionedMatcher(table, compact=mode)
+                m.match(pool[:bb])  # warm/settle
+                m.match(pool[:bb])
+                pending = deque()
+                done = 0
+                t0 = time.perf_counter()
+                for r in range(args.rounds):
+                    sl = pool[(r % 4) * bb : (r % 4) * bb + bb]
+                    pending.append(m.match_submit(sl))
+                    if len(pending) >= depth:
+                        m.match_complete(pending.popleft())
+                        done += bb
+                while pending:
                     m.match_complete(pending.popleft())
                     done += bb
-            while pending:
-                m.match_complete(pending.popleft())
-                done += bb
-            dt = time.perf_counter() - t0
-            print(f"sweep B={bb:6d} depth={depth} kw={m.max_words:3d}: "
-                  f"{done / dt:10.0f} topics/s ({dt / args.rounds * 1e3:.0f} ms/batch)")
+                dt = time.perf_counter() - t0
+                print(f"sweep {mode:6s} B={bb:6d} depth={depth}: "
+                      f"{done / dt:10.0f} topics/s ({dt / args.rounds * 1e3:.0f} ms/batch)")
 
 
 if __name__ == "__main__":

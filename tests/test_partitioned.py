@@ -295,3 +295,71 @@ def test_native_decode_matches_numpy():
     assert len(got) == len(want) == b
     for g, w in zip(got, want):
         assert g.tolist() == w.tolist()
+
+
+def test_global_vs_topk_compaction_parity():
+    """The batch-global compaction (default) and the per-topic top_k path
+    must produce identical routing results."""
+    table, fids, rng = build_random(47, 2000)
+    topics = [
+        "/".join(rng.choice(["a", "b", "c", "d", "", "$m"]) for _ in range(rng.randint(1, 6)))
+        for _ in range(96)
+    ]
+    mg = PartitionedMatcher(table, compact="global")
+    mk = PartitionedMatcher(table, compact="topk")
+    got_g = mg.match(topics)
+    got_k = mk.match(topics)
+    for topic, g, k in zip(topics, got_g, got_k):
+        assert g.tolist() == k.tolist(), topic
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert g.tolist() == expect, topic
+
+
+def test_global_budget_regrow():
+    """A too-small slot budget must regrow (sticky) and still return exact
+    results — total is computed from the untruncated mask on device."""
+    table = PartitionedTable()
+    expect = sorted(table.add("a/+/#") for _ in range(200))
+    m = PartitionedMatcher(table, compact="global")
+    m._budget = 4  # force overflow: 200 matches span many words
+    rows = m.match(["a/b/c", "a/x/y"])
+    assert m._budget >= 4096  # regrown to the floor or above
+    for row in rows:
+        assert row.tolist() == expect
+    # next batch goes through without a rerun at the grown budget
+    (row,) = m.match(["a/q/r"])
+    assert row.tolist() == expect
+
+
+def test_flat_decode_native_matches_numpy():
+    """rt_match_decode_flat (C++) vs the numpy flat-decode oracle on random
+    global-compaction entries."""
+    import numpy as np
+
+    from rmqtt_tpu import runtime as rt
+    from rmqtt_tpu.ops.partitioned import (
+        CHUNK,
+        WORDS_PER_CHUNK,
+        _native_decode_flat,
+        _numpy_decode_flat,
+    )
+
+    if rt.load() is None:
+        import pytest
+
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(17)
+    b, nc, nchunks = 64, 4, 16
+    w_total = nc * WORDS_PER_CHUNK
+    # ascending unique flat keys (topic-major), sparse coverage
+    all_keys = rng.choice(b * w_total, size=300, replace=False)
+    keys = np.sort(all_keys).astype(np.uint32)
+    bits = rng.integers(1, 1 << 32, size=keys.shape[0], dtype=np.uint32)
+    chunk_ids = rng.integers(0, nchunks, size=(b, nc)).astype(np.int32)
+    fid_map = rng.integers(0, 1 << 31, size=nchunks * CHUNK).astype(np.int64)
+    got = _native_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    assert got is not None
+    want = _numpy_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    assert len(got) == len(want) == b
+    for g, w in zip(got, want):
+        assert g.tolist() == w.tolist()
