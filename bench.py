@@ -480,7 +480,7 @@ def main():
         def cfg3():
             filters = gen_mixed(rng, 1_000_000)
             topics = gen_topics_uniform(rng, 32_768)
-            return run_config("cfg3_mixed_1m", filters, topics, 4096, 256)
+            return run_config("cfg3_mixed_1m", filters, topics, 16384, 256)
 
         guarded("cfg3_mixed_1m", cfg3)
 
@@ -488,7 +488,7 @@ def main():
         def cfg4():
             filters = gen_mixed(rng, 10_000_000, shared_frac=0.1)
             topics = gen_topics_zipf(rng, 16_384)
-            return run_config("cfg4_shared_10m_zipf", filters, topics, 1024, 64)
+            return run_config("cfg4_shared_10m_zipf", filters, topics, 8192, 64)
 
         guarded("cfg4_shared_10m_zipf", cfg4)
 
@@ -497,7 +497,7 @@ def main():
             filters = gen_mixed(rng, 10_000_000, shared_frac=0.05)
             topics = gen_topics_zipf(rng, 16_384)
             retained = list({_tree_topic(rng, rng.randint(3, 6)) for _ in range(1_000_000)})
-            return run_config("cfg5_retained_10m", filters, topics, 1024, 64, retained=retained)
+            return run_config("cfg5_retained_10m", filters, topics, 8192, 64, retained=retained)
 
         guarded("cfg5_retained_10m", cfg5)
 

@@ -121,9 +121,9 @@ def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
         out_shape=jax.ShapeDtypeStruct((b, nc * wpc), jnp.uint32),
         interpret=interpret,
     )(
-        ttok,
+        ttok.astype(jnp.int32),
         tlen.astype(jnp.int32).reshape(b, 1),
         tdollar.astype(jnp.int32).reshape(b, 1),
-        chunk_ids,
+        chunk_ids.astype(jnp.int32),
         packed_rows,
     )

@@ -363,3 +363,35 @@ def test_flat_decode_native_matches_numpy():
     assert len(got) == len(want) == b
     for g, w in zip(got, want):
         assert g.tolist() == w.tolist()
+
+
+def test_upload_dtype_narrowing():
+    """ttok/chunk_ids upload as uint16 (tlen int16) while ids fit, widen
+    stickily to int32, and both widths route identically."""
+    table = PartitionedTable()
+    fid = table.add("a/b/c")
+    ttok, tlen, _td, cand, _nc = table.encode_topics(["a/b/c", "x/y"])
+    assert ttok.dtype == np.uint16 and cand.dtype == np.uint16
+    assert tlen.dtype == np.int16
+    m = PartitionedMatcher(table)
+    r1, r2 = m.match(["a/b/c", "x/y"])
+    assert r1.tolist() == [fid] and r2.tolist() == []
+    table._tok_wide = True
+    table._cand_wide = True  # as if vocab/chunk ids outgrew uint16
+    ttok, tlen, _td, cand, _nc = table.encode_topics(["a/b/c"])
+    assert ttok.dtype == np.int32 and cand.dtype == np.int32
+    (r1,) = m.match(["a/b/c"])
+    assert r1.tolist() == [fid]
+
+
+def test_hostile_topic_depth_clamped():
+    """A pathologically deep topic (thousands of levels) must not wrap the
+    int16 tlen — it routes exactly like any topic deeper than max_levels."""
+    table = PartitionedTable()
+    f_hash = table.add("#")
+    f_pfx = table.add("a/#")
+    f_exact = table.add("a/b")
+    m = PartitionedMatcher(table)
+    deep = "a/" + "/".join(str(i) for i in range(40000))
+    (row,) = m.match([deep])
+    assert row.tolist() == sorted([f_hash, f_pfx]) and f_exact not in row.tolist()
