@@ -45,7 +45,11 @@ class Connack:
     properties: Properties = field(default_factory=dict)
 
 
-@dataclass
+# slots=True on the per-message hot classes: ~30% cheaper construction and
+# no per-instance __dict__ (the broker creates one Publish per inbound
+# message and one per delivery); subclasses declare empty __slots__ so they
+# don't silently grow a __dict__ back
+@dataclass(slots=True)
 class Publish:
     topic: str
     payload: bytes = b""
@@ -56,7 +60,7 @@ class Publish:
     properties: Properties = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(slots=True)
 class _Ack:
     packet_id: int
     reason_code: int = 0
@@ -64,19 +68,19 @@ class _Ack:
 
 
 class Puback(_Ack):
-    pass
+    __slots__ = ()
 
 
 class Pubrec(_Ack):
-    pass
+    __slots__ = ()
 
 
 class Pubrel(_Ack):
-    pass
+    __slots__ = ()
 
 
 class Pubcomp(_Ack):
-    pass
+    __slots__ = ()
 
 
 @dataclass

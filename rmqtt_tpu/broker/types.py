@@ -20,7 +20,7 @@ def now() -> float:
     return time.time()
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Message:
     """A publish in flight through the broker (reference types.rs `Publish`)."""
 

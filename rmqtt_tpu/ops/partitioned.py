@@ -701,7 +701,11 @@ class PartitionedMatcher:
         # ~4x less device→host transfer than per-topic top_k at measured
         # match rates); 'topk' = per-topic fixed-width slots
         self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
-        self._budget = 0  # sticky pow2 slot budget for 'global' mode
+        # sticky pow2 slot budgets for 'global' mode, PER padded batch size:
+        # one shared budget would let a 16K-topic batch (e.g. 128K slots)
+        # inflate every later 1-topic match's fetch to megabytes — the
+        # low-load p99 path must keep its own small budget
+        self._budgets: Dict[int, int] = {}
         self._dev_version = -1
         self._dev_arrays = None
         self._pallas: Optional[bool] = None  # None = not decided yet
@@ -792,9 +796,10 @@ class PartitionedMatcher:
         dev = self._refresh()
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
-            if not self._budget:
-                self._budget = max(4096, 1 << (4 * padded - 1).bit_length())
-            g = self._budget
+            g = self._budgets.get(padded)
+            if g is None:
+                g = max(256, 1 << (4 * padded - 1).bit_length())
+                self._budgets[padded] = g
             if words is not None:
                 keys, bits, total = _compact_global(words, budget=g)
             else:
@@ -839,12 +844,14 @@ class PartitionedMatcher:
 
     def _complete_global(self, handle) -> List[np.ndarray]:
         _tag, b, chunk_ids, words, dev_inputs, keys, bits, total, g = handle
+        padded = chunk_ids.shape[0]
         while True:
             n = int(total)  # total is exact even when the scatter truncated
             if n <= g:
                 break
-            g = 1 << max(12, (n - 1).bit_length())
-            self._budget = max(self._budget, g)  # sticky pow2 regrow
+            g = 1 << max(8, (n - 1).bit_length())
+            # sticky pow2 regrow for this batch size
+            self._budgets[padded] = max(self._budgets.get(padded, 0), g)
             if words is not None:
                 keys, bits, total = _compact_global(words, budget=g)
             else:

@@ -29,6 +29,7 @@ class TestClient:
         self.codec = codec
         self.version = version
         self.publishes: asyncio.Queue = asyncio.Queue()
+        self.wire_empty_log: List[bool] = []  # per received PUBLISH, in order
         self._acks: Dict[tuple, asyncio.Future] = {}
         self.connack: Optional[pk.Connack] = None
         self.disconnect: Optional[pk.Disconnect] = None
@@ -108,6 +109,13 @@ class TestClient:
                     await self._on_packet(p)
         except (ConnectionError, asyncio.CancelledError):
             pass
+        except Exception:  # pragma: no cover - harness bug surface
+            # a client bug must not present as a silent delivery timeout:
+            # log loudly so the failing test points at the real cause
+            import traceback
+
+            traceback.print_exc()
+            raise
         finally:
             self.closed.set()
 
@@ -118,7 +126,9 @@ class TestClient:
             from rmqtt_tpu.broker.codec import props as _props
 
             alias = p.properties.get(_props.TOPIC_ALIAS)
-            p.wire_topic_empty = not p.topic
+            # Publish is slotted: record the on-wire empty-topic fact (alias
+            # deliveries) in a client-side log, in delivery order
+            self.wire_empty_log.append(not p.topic)
             if alias is not None:
                 if p.topic:
                     self._alias_map[alias] = p.topic

@@ -321,9 +321,12 @@ def test_global_budget_regrow():
     table = PartitionedTable()
     expect = sorted(table.add("a/+/#") for _ in range(200))
     m = PartitionedMatcher(table, compact="global")
-    m._budget = 4  # force overflow: 200 matches span many words
+    m.match(["a/0/0", "a/0/1"])  # settle pallas (first batch pads to BT)
+    m.match(["a/0/0", "a/0/1"])  # settle the steady 2-topic bucket
+    bucket = min(m._budgets)  # the smallest bucket = the 2-topic one
+    m._budgets[bucket] = 4  # force overflow: 200 matches span many words
     rows = m.match(["a/b/c", "a/x/y"])
-    assert m._budget >= 4096  # regrown to the floor or above
+    assert m._budgets[bucket] >= 256  # regrown for this batch size
     for row in rows:
         assert row.tolist() == expect
     # next batch goes through without a rerun at the grown budget
