@@ -6,7 +6,8 @@ RS256/384/512 verify with a configured RSA public key — signature
 VERIFICATION is one modular exponentiation (``pow(sig, e, n)``) plus
 PKCS#1 v1.5 / DigestInfo checking, all stdlib (the public key is given as
 a JWK dict ``{n, e}`` or a PEM SubjectPublicKeyInfo, parsed with a minimal
-DER reader). ES* would need EC point math and stays unimplemented.
+DER reader). ES256/384/512 verify via ``rmqtt_tpu.utils.ec`` (pure-Python
+NIST-curve ECDSA; key = JWK ``{x, y}`` or an EC SubjectPublicKeyInfo PEM).
 Claims honored: ``exp`` (reject expired), optional ``%c``/``%u`` matching
 claims, ``superuser``, and ``acl`` pub/sub filter lists enforced on the
 ACL hooks.
@@ -54,8 +55,9 @@ def _der_read(buf: bytes, pos: int):
     return tag, buf[pos : pos + length], pos + length
 
 
-def rsa_public_key_from_pem(pem: str):
-    """SubjectPublicKeyInfo PEM → (n, e). Minimal DER walk, stdlib only."""
+def _spki_bitstring(pem: str) -> bytes:
+    """SubjectPublicKeyInfo PEM → BIT STRING content (unused-bits stripped).
+    Shared prefix walk for the RSA and EC key parsers."""
     body = "".join(
         line for line in pem.strip().splitlines() if not line.startswith("-----")
     )
@@ -63,9 +65,20 @@ def rsa_public_key_from_pem(pem: str):
     _, spki, _ = _der_read(der, 0)  # SEQUENCE SubjectPublicKeyInfo
     _, _alg, after_alg = _der_read(spki, 0)  # SEQUENCE AlgorithmIdentifier
     tag, bitstr, _ = _der_read(spki, after_alg)  # BIT STRING
-    if tag != 0x03:
+    if tag != 0x03 or not bitstr:
         raise ValueError("not a SubjectPublicKeyInfo key")
-    _, rsa_seq, _ = _der_read(bitstr[1:], 0)  # skip unused-bits byte; SEQUENCE
+    return bitstr[1:]  # skip unused-bits byte
+
+
+def rsa_public_key_from_pem(pem: str):
+    """SubjectPublicKeyInfo PEM → (n, e). Minimal DER walk, stdlib only."""
+    content = _spki_bitstring(pem)
+    if not content or content[0] != 0x30:
+        # RSA keys carry a DER SEQUENCE here; anything else (e.g. an EC
+        # point, incl. compressed 0x02/0x03 forms) must fail loudly, not
+        # be walked as garbage TLVs
+        raise ValueError("not an RSA SubjectPublicKeyInfo key")
+    _, rsa_seq, _ = _der_read(content, 0)  # SEQUENCE
     _, n_bytes, after_n = _der_read(rsa_seq, 0)  # INTEGER n
     _, e_bytes, _ = _der_read(rsa_seq, after_n)  # INTEGER e
     return int.from_bytes(n_bytes, "big"), int.from_bytes(e_bytes, "big")
@@ -83,9 +96,28 @@ def verify_rs_signature(alg: str, signed: bytes, sig: bytes, n: int, e: int) -> 
     return hmac.compare_digest(em, expected)
 
 
-def verify_hs_jwt(token: str, secret: bytes, rsa_key=None) -> Optional[dict]:
+def ec_public_key_from_pem(pem: str):
+    """EC SubjectPublicKeyInfo PEM → (x, y) of the uncompressed point.
+    Compressed points (0x02/0x03 marker) are rejected with a clear error —
+    re-export with ``openssl ec -pubout`` (uncompressed is its default)."""
+    content = _spki_bitstring(pem)
+    if not content or content[0] in (0x02, 0x03):
+        raise ValueError(
+            "compressed EC public key unsupported; re-export uncompressed"
+        )
+    if content[0] != 0x04:
+        raise ValueError("not an uncompressed EC SubjectPublicKeyInfo key")
+    point = content[1:]
+    half = len(point) // 2
+    return int.from_bytes(point[:half], "big"), int.from_bytes(point[half:], "big")
+
+
+def verify_hs_jwt(token: str, secret: bytes, rsa_key=None, ec_key=None) -> Optional[dict]:
     """→ claims dict, or None if invalid/expired. ``rsa_key`` is (n, e) for
-    the RS* algorithms; HS* verify against ``secret``."""
+    the RS* algorithms, ``ec_key`` is the (x, y) public point for ES*;
+    HS* verify against ``secret``."""
+    from rmqtt_tpu.utils import ec
+
     try:
         head_b64, payload_b64, sig_b64 = token.split(".")
         header = json.loads(_b64url_decode(head_b64))
@@ -101,6 +133,9 @@ def verify_hs_jwt(token: str, secret: bytes, rsa_key=None) -> Optional[dict]:
                 return None
         elif alg in _RS_ALGS and rsa_key is not None:
             if not verify_rs_signature(alg, signed, _b64url_decode(sig_b64), *rsa_key):
+                return None
+        elif alg in ec.CURVES and ec_key is not None:
+            if not ec.verify(alg, signed, _b64url_decode(sig_b64), ec_key):
                 return None
         else:
             return None
@@ -122,16 +157,30 @@ class AuthJwtPlugin(Plugin):
         secret = self.config.get("secret", "")
         self.secret = secret.encode() if isinstance(secret, str) else bytes(secret)
         self.from_field = self.config.get("from", "password")  # password | username
-        # RS256/384/512: public key as JWK {n, e} (base64url) or PEM string
+        # RS*: public key as JWK {n, e}; ES*: JWK {x, y}; either as PEM
         self.rsa_key = None
+        self.ec_key = None
         jwk = self.config.get("jwk")
-        if jwk:
+        if jwk and "n" in jwk:
             self.rsa_key = (
                 int.from_bytes(_b64url_decode(jwk["n"]), "big"),
                 int.from_bytes(_b64url_decode(jwk["e"]), "big"),
             )
+        elif jwk and "x" in jwk:
+            self.ec_key = (
+                int.from_bytes(_b64url_decode(jwk["x"]), "big"),
+                int.from_bytes(_b64url_decode(jwk["y"]), "big"),
+            )
         elif self.config.get("public_key_pem"):
-            self.rsa_key = rsa_public_key_from_pem(self.config["public_key_pem"])
+            pem = self.config["public_key_pem"]
+            # RSA keys carry a DER SEQUENCE (0x30) in the SPKI BIT STRING;
+            # EC keys carry a raw point — dispatch on that, so a compressed
+            # EC key surfaces ec_public_key_from_pem's clear error instead
+            # of an RSA misparse
+            if _spki_bitstring(pem)[:1] == b"\x30":
+                self.rsa_key = rsa_public_key_from_pem(pem)
+            else:
+                self.ec_key = ec_public_key_from_pem(pem)
         self._claims: Dict[str, dict] = {}
         self._unhooks = []
 
@@ -147,7 +196,8 @@ class AuthJwtPlugin(Plugin):
             )
             if not token:
                 return None  # not a JWT client; fall through
-            claims = verify_hs_jwt(token, self.secret, rsa_key=self.rsa_key)
+            claims = verify_hs_jwt(token, self.secret, rsa_key=self.rsa_key,
+                                   ec_key=self.ec_key)
             if claims is None:
                 return HookResult(proceed=False, value=False)
             # optional identity-claim checks (reference %c/%u placeholders)

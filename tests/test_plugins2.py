@@ -409,3 +409,70 @@ def test_auth_jwt_rs256(tmp_path):
     assert verify_hs_jwt(bad, b"", rsa_key=rsa_key) is None
     # RS token without a configured key must fail closed
     assert verify_hs_jwt(token, b"secret", rsa_key=None) is None
+
+
+def test_ec_curve_constants_and_roundtrip():
+    """The embedded NIST curve constants must satisfy the curve equation
+    (G on curve) and group order (n*G = infinity); sign/verify round-trips
+    and rejects tampering for each ES* algorithm."""
+    from rmqtt_tpu.utils import ec
+
+    for alg, c in ec.CURVES.items():
+        assert ec.on_curve(c, (c.gx, c.gy)), alg
+        assert ec._mul(c, c.n, (c.gx, c.gy)) is None, alg  # order check
+        priv = 0xC0FFEE ^ c.n // 3
+        pub = ec.public_key(alg, priv)
+        assert ec.on_curve(c, pub), alg
+        sig = ec.sign(alg, b"signed-bytes", priv)
+        assert ec.verify(alg, b"signed-bytes", sig, pub), alg
+        assert not ec.verify(alg, b"signed-bytes!", sig, pub), alg
+        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        assert not ec.verify(alg, b"signed-bytes", bad, pub), alg
+
+
+def test_auth_jwt_es256(tmp_path):
+    """ES256 verification against a token signed by openssl (independent
+    signer): pure-Python P-256 ECDSA + EC SubjectPublicKeyInfo PEM parse."""
+    import base64
+    import json
+    import subprocess
+
+    from rmqtt_tpu.plugins.auth_jwt import ec_public_key_from_pem, verify_hs_jwt
+
+    key = tmp_path / "ec.key"
+    pub = tmp_path / "ec.pub"
+    subprocess.run(
+        ["openssl", "ecparam", "-name", "prime256v1", "-genkey", "-noout",
+         "-out", str(key)], check=True, capture_output=True)
+    subprocess.run(["openssl", "ec", "-in", str(key), "-pubout", "-out", str(pub)],
+                   check=True, capture_output=True)
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    header = b64url(json.dumps({"alg": "ES256", "typ": "JWT"}).encode())
+    payload = b64url(json.dumps({"sub": "dev-2", "superuser": False}).encode())
+    signing_input = f"{header}.{payload}".encode()
+    blob = tmp_path / "in.bin"
+    blob.write_bytes(signing_input)
+    der_sig = subprocess.run(
+        ["openssl", "dgst", "-sha256", "-sign", str(key), str(blob)],
+        check=True, capture_output=True,
+    ).stdout
+    # openssl emits DER SEQUENCE{r, s}; JWT ES* wants raw r||s
+    from rmqtt_tpu.plugins.auth_jwt import _der_read
+
+    _, seq, _ = _der_read(der_sig, 0)
+    _, r_b, after_r = _der_read(seq, 0)
+    _, s_b, _ = _der_read(seq, after_r)
+    raw = (int.from_bytes(r_b, "big").to_bytes(32, "big")
+           + int.from_bytes(s_b, "big").to_bytes(32, "big"))
+    token = f"{header}.{payload}.{b64url(raw)}"
+
+    ec_key = ec_public_key_from_pem(pub.read_text())
+    claims = verify_hs_jwt(token, b"", ec_key=ec_key)
+    assert claims == {"sub": "dev-2", "superuser": False}
+    bad = f"{header}.{b64url(json.dumps({'sub': 'evil'}).encode())}.{b64url(raw)}"
+    assert verify_hs_jwt(bad, b"", ec_key=ec_key) is None
+    # ES token without a configured key must fail closed
+    assert verify_hs_jwt(token, b"secret", ec_key=None) is None
