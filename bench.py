@@ -463,7 +463,7 @@ def main():
             filters = gen_exact(rng, n)
             # ~50% of publishes hit a subscribed topic
             topics = [rng.choice(filters) if rng.random() < 0.5 else _tree_topic(rng, 4) for _ in range(4096)]
-            return run_config("cfg1_exact_1k", filters, topics, 1024, 1024)
+            return run_config("cfg1_exact_1k", filters, topics, 4096, 1024)
 
         guarded("cfg1_exact_1k", cfg1)
 
@@ -472,7 +472,7 @@ def main():
             filters = gen_single_plus(rng, 100_000)
             # depth 3-5 filters over l{d}n{...} names: generate matching-shape topics
             topics = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5))) for _ in range(20_000)]
-            return run_config("cfg2_plus_100k", filters, topics, 2048, 512)
+            return run_config("cfg2_plus_100k", filters, topics, 8192, 512)
 
         guarded("cfg2_plus_100k", cfg2)
 

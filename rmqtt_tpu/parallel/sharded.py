@@ -120,13 +120,51 @@ class ShardedPartitionedMatcher:
     the ``fp``-sharded dense path above is the scatter-gather analogue.
     """
 
-    def __init__(self, table, mesh: Mesh, max_words: int = 32) -> None:
+    def __init__(self, table, mesh: Mesh, max_words: int = 32,
+                 compact: Optional[str] = None) -> None:
+        import os
+
         self.table = table
         self.mesh = mesh
         self.ndev = int(np.prod(list(mesh.shape.values())))
         self.max_words = max_words
+        # same two modes as the local PartitionedMatcher: 'global' compacts
+        # per DEVICE (each shard prefix-sums its own topic slice into its
+        # own slot budget; keys offset by shard index stay globally
+        # topic-major), 'topk' is the per-topic fixed-width fallback
+        self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
+        self._budgets = {}  # padded batch size -> sticky pow2 PER-DEVICE slots
+        self._gsteps = {}  # per-device budget -> jitted shard_map step
         self._dev_version = -1
         self._dev_rows = None
+
+    def _global_step(self, budget_per_dev: int):
+        step = self._gsteps.get(budget_per_dev)
+        if step is not None:
+            return step
+        from rmqtt_tpu.ops.partitioned import compact_global_impl, scan_words_impl
+
+        fp = self.mesh.shape["fp"]
+        axes = ("dp", "fp")
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), P(axes, None), P(axes), P(axes), P(axes, None)),
+            out_specs=(P(axes), P(axes), P(axes)),
+        )
+        def gstep(rows, ttok, tlen, td, cids):
+            words = scan_words_impl(rows, ttok, tlen, td, cids)
+            keys, bits, total = compact_global_impl(words, budget_per_dev)
+            shard = lax.axis_index("dp") * fp + lax.axis_index("fp")
+            bl, w = words.shape
+            # rebase local flat keys to the global topic index space
+            keys = keys + jnp.uint32(shard * bl * w)
+            return keys, bits, total[None]
+
+        step = jax.jit(gstep)
+        self._gsteps[budget_per_dev] = step
+        return step
 
     def _refresh(self):
         from rmqtt_tpu.ops.partitioned import pack_device_rows
@@ -158,6 +196,8 @@ class ShardedPartitionedMatcher:
             jax.device_put(tdollar, batch_spec),
             jax.device_put(chunk_ids, row_spec),
         )
+        if self.compact_mode == "global":
+            return self._match_global(dev, inputs, chunk_ids, b, padded)
         while True:
             wi, wb, cn = _match_partitioned(dev, *inputs, max_words=self.max_words)
             wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
@@ -167,3 +207,29 @@ class ShardedPartitionedMatcher:
             # device; no re-encode/re-upload)
             self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
         return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
+
+    def _match_global(self, dev, inputs, chunk_ids, b: int, padded: int) -> list:
+        from rmqtt_tpu.ops.partitioned import _decode_flat
+
+        gd = self._budgets.get(padded)
+        if gd is None:
+            gd = max(256, 1 << (4 * (padded // self.ndev) - 1).bit_length())
+            self._budgets[padded] = gd
+        while True:
+            keys, bits, totals = self._global_step(gd)(dev, *inputs)
+            totals = np.asarray(totals)
+            mx = int(totals.max(initial=0))
+            if mx <= gd:
+                break
+            # a shard overflowed its slice: regrow (sticky) and re-run
+            gd = 1 << max(8, (mx - 1).bit_length())
+            self._budgets[padded] = max(self._budgets[padded], gd)
+        keys, bits = np.asarray(keys), np.asarray(bits)
+        # concatenate each shard's valid prefix; keys are already rebased to
+        # the global topic space and shard-major == topic-major
+        parts_k = [keys[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
+        parts_b = [bits[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
+        return _decode_flat(
+            np.concatenate(parts_k), np.concatenate(parts_b),
+            chunk_ids[:b], b, self.table._fid_of_row,
+        )

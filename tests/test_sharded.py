@@ -129,3 +129,46 @@ def test_broker_with_mesh_router():
             await b.stop()
 
     asyncio.run(asyncio.wait_for(run(), 120))
+
+
+def test_sharded_global_vs_topk_and_regrow():
+    """Sharded per-device global compaction == sharded topk == oracle, and
+    a forced per-shard budget overflow regrows and still returns exact
+    results."""
+    import random
+
+    from rmqtt_tpu.core.topic import filter_valid, match_filter
+    from rmqtt_tpu.ops.partitioned import PartitionedTable
+    from rmqtt_tpu.parallel.sharded import ShardedPartitionedMatcher, make_mesh
+
+    rng = random.Random(91)
+    table = PartitionedTable()
+    fids = {}
+    words = ["a", "b", "", "+"]
+    while len(fids) < 600:
+        levels = [rng.choice(words) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.35:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if filter_valid(f):
+            fids[table.add(f)] = f
+    mesh = make_mesh(dp=2, fp=4)
+    topics = [
+        "/".join(rng.choice(["a", "b", "x", ""]) for _ in range(rng.randint(1, 5)))
+        for _ in range(64)
+    ]
+    mg = ShardedPartitionedMatcher(table, mesh, compact="global")
+    mk = ShardedPartitionedMatcher(table, mesh, compact="topk")
+    got_g = mg.match(topics)
+    got_k = mk.match(topics)
+    for topic, g, k in zip(topics, got_g, got_k):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert g.tolist() == expect, topic
+        assert k.tolist() == expect, topic
+    # force a per-shard overflow and re-match: sticky regrow, same results
+    for key in list(mg._budgets):
+        mg._budgets[key] = 2
+    got_o = mg.match(topics)
+    assert all(v >= 256 for v in mg._budgets.values())
+    for g, o in zip(got_g, got_o):
+        assert g.tolist() == o.tolist()
