@@ -140,8 +140,9 @@ class PartitionedTable:
         self.size = 0
         self.version = 0
         self.dirty_ops = 0  # mutations since the last compact()
-        # per-(t0[,t1[,t2]]) candidate-chunk-list caches, invalidated on mutation
-        self._cand_cache: Dict[Tuple, np.ndarray] = {}
+        # per-(t0[,t1[,t2]]) candidate caches: key -> (chunk ids, gid);
+        # invalidated on mutation
+        self._cand_cache: Dict[Tuple, Tuple[np.ndarray, int]] = {}
         self._cand_version = -1
         # native (C++) encoder: None = not tried yet, False = unavailable
         self._nenc = None
@@ -425,13 +426,18 @@ class PartitionedTable:
         return np.asarray(chunks, dtype=np.int32)
 
     def encode_topics(
-        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
-        """→ (ttok, tlen, tdollar, chunk_ids [B, NC], nc).
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None,
+        with_groups: bool = False,
+    ):
+        """→ (ttok, tlen, tdollar, chunk_ids [B, NC], nc)
+        (+ ``groups`` [B] int32 when ``with_groups``).
 
         ``chunk_ids`` lists each topic's candidate chunks padded with the
         reserved empty chunk 0; NC is the batch max (padded to a power of
-        two to bound recompiles).
+        two to bound recompiles). ``groups`` assigns topics sharing one
+        candidate-cache entry the same positive id (0 = padded row): the
+        matcher can then upload each distinct candidate row once (zipf
+        publish streams share a few hot prefixes across the whole batch).
         """
         if self.dirty_ops > max(1024, self.size // 5):
             # heavy churn fragments the layout; rebuild before encoding so
@@ -447,7 +453,7 @@ class PartitionedTable:
             except (RuntimeError, OSError):
                 self._nenc = False
         if self._nenc:
-            return self._encode_native(topics, pad_batch_to)
+            return self._encode_native(topics, pad_batch_to, with_groups)
         batch = len(topics)
         b = pad_batch_to or batch
         lvl = self.max_levels
@@ -460,6 +466,7 @@ class PartitionedTable:
             self._cand_cache.clear()
             self._cand_version = self.version
         cache = self._cand_cache
+        groups = np.full((b,), -1, dtype=np.int32)
         for j, topic in enumerate(topics):
             levels = split_levels(topic) if isinstance(topic, str) else list(topic)
             # clamp: every stored flen/prefix_len is <= max_levels, so any
@@ -476,10 +483,12 @@ class PartitionedTable:
             # (1, 2 or 3 depending on topic depth).
             ckey = tuple(levels[:3]) if len(levels) >= 3 else tuple(levels)
             ckey = (len(ckey),) + ckey
-            cand = cache.get(ckey)
-            if cand is None:
-                cand = self._candidates_for(levels)
-                cache[ckey] = cand
+            ent = cache.get(ckey)
+            if ent is None:
+                ent = (self._candidates_for(levels), len(cache))
+                cache[ckey] = ent
+            cand, gid = ent
+            groups[j] = gid
             per_topic_chunks.append(cand)
         ttok = np.zeros((b, lvl), dtype=self._tok_dtype())
         if batch:
@@ -492,11 +501,14 @@ class PartitionedTable:
         chunk_ids = np.zeros((b, nc), dtype=self._cand_dtype())  # 0 = empty chunk
         for j, chunks in enumerate(per_topic_chunks):
             chunk_ids[j, : len(chunks)] = chunks
+        if with_groups:
+            return ttok, tlen, tdollar, chunk_ids, nc, groups + 1  # padded -> 0
         return ttok, tlen, tdollar, chunk_ids, nc
 
     def _encode_native(
-        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int]
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int],
+        with_groups: bool = False,
+    ):
         """C++ hot path for ``encode_topics`` (runtime/encode.cc): tokenize +
         candidate-cache lookup natively; only distinct-prefix cache misses
         walk the Python partition maps."""
@@ -521,14 +533,26 @@ class PartitionedTable:
             tdollar = np.zeros((b,), dtype=np.uint8)
             cand = np.zeros((b, nc_cap), dtype=np.int32)
             counts = np.zeros((b,), dtype=np.int32)
+            group = np.full((b,), -1, dtype=np.int32)  # padded rows stay -1
             if batch:
                 miss = enc.encode(
-                    blob, batch, lvl, ttok, tlen, tdollar, nc_cap, cand, counts
+                    blob, batch, lvl, ttok, tlen, tdollar, nc_cap, cand, counts,
+                    group,
                 )
+                # dedupe misses by prefix key: a cold cache (fresh table
+                # version) must not hand every repeated hot topic its own
+                # gid — that would disable the grouped upload exactly when
+                # it pays most
+                put: Dict[bytes, Tuple[int, np.ndarray]] = {}
                 for j in miss:
                     levels = split_levels(topics[j])
-                    chunks = self._candidates_for(levels)
-                    enc.cache_put("/".join(levels[:3]).encode(), chunks)
+                    key = "/".join(levels[:3]).encode()
+                    hit = put.get(key)
+                    if hit is None:
+                        chunks = self._candidates_for(levels)
+                        hit = (enc.cache_put(key, chunks), chunks)
+                        put[key] = hit
+                    group[j], chunks = hit
                     counts[j] = len(chunks)
                     cand[j, : min(len(chunks), nc_cap)] = chunks[:nc_cap]
             mx = int(counts.max(initial=1))
@@ -540,10 +564,11 @@ class PartitionedTable:
             # narrowing copy is ~0.5ms/16K vs ~25ms less tunnel time).
             # tlen clamps like the python path: comparisons are invariant
             # beyond lvl+1 and hostile topic depths must not wrap int16
-            return (ttok.astype(self._tok_dtype(), copy=False),
-                    np.minimum(tlen, lvl + 1).astype(np.int16, copy=False),
-                    tdollar.view(bool),
-                    cand.astype(self._cand_dtype(), copy=False), nc_cap)
+            out = (ttok.astype(self._tok_dtype(), copy=False),
+                   np.minimum(tlen, lvl + 1).astype(np.int16, copy=False),
+                   tdollar.view(bool),
+                   cand.astype(self._cand_dtype(), copy=False), nc_cap)
+            return out + (group + 1,) if with_groups else out  # padded -> 0
 
 
 def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
@@ -633,7 +658,18 @@ def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int):
     return compact_global_impl(words, budget)
 
 
+def match_global_grouped_impl(packed_rows, ttok, tlen, tdollar, uniq_cand, inv,
+                              budget: int):
+    """Global match with DEDUPLICATED candidate rows: upload [U, NC] distinct
+    rows + a [B] inverse instead of [B, NC] (zipf publish streams share a
+    few hot prefixes across the whole batch); the full per-topic chunk-id
+    matrix is rebuilt by one device gather."""
+    chunk_ids = uniq_cand[inv.astype(jnp.int32)]
+    return match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget)
+
+
 _match_global = jax.jit(match_global_impl, static_argnames=("budget",))
+_match_global_grouped = jax.jit(match_global_grouped_impl, static_argnames=("budget",))
 _compact_global = jax.jit(compact_global_impl, static_argnames=("budget",))
 
 
@@ -790,9 +826,11 @@ class PartitionedMatcher:
                     self._pallas = False
         else:
             padded = b
-        ttok, tlen, tdollar, chunk_ids, _nc = self.table.encode_topics(
-            topics, pad_batch_to=padded
+        want_groups = self.compact_mode == "global"
+        enc = self.table.encode_topics(
+            topics, pad_batch_to=padded, with_groups=want_groups
         )
+        ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
         dev = self._refresh()
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
@@ -802,13 +840,20 @@ class PartitionedMatcher:
                 self._budgets[padded] = g
             if words is not None:
                 keys, bits, total = _compact_global(words, budget=g)
+                grouped = None
             else:
-                keys, bits, total = _match_global(
-                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
-                )
+                grouped = self._group_inputs(enc[5], chunk_ids)
+                if grouped is None:  # batch doesn't dedup; plain upload
+                    keys, bits, total = _match_global(
+                        dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                    )
+                else:
+                    keys, bits, total = _match_global_grouped(
+                        dev, ttok, tlen, tdollar, *grouped, budget=g
+                    )
             # the handle carries ITS OWN budget: a sticky widening by a later
             # handle must not mask this one's truncation
-            return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar),
+            return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
                     keys, bits, total, g)
         wi, wb, cn = (
             _compact_words(words, max_words=self.max_words)
@@ -842,6 +887,27 @@ class PartitionedMatcher:
                 )
         return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
 
+    def _group_inputs(self, groups: np.ndarray, chunk_ids: np.ndarray):
+        """→ (uniq_cand [U_pow2, NC], inv [B]) for the grouped upload, or
+        None when the batch doesn't dedup (synthetic uniform streams barely
+        share prefixes; live MQTT traffic — devices republishing the same
+        topics — is where U collapses and the upload shrinks)."""
+        uq, first_idx, inv = np.unique(
+            groups, return_index=True, return_inverse=True
+        )
+        u = len(uq)
+        u_pow2 = 1 << (max(1, u) - 1).bit_length()
+        if u_pow2 >= groups.shape[0]:
+            # no dedup (or a batch so small the pow2 bucket erases it):
+            # the plain [B, NC] upload is strictly cheaper
+            return None
+        self._u_cap = max(getattr(self, "_u_cap", 1), u_pow2)
+        uniq_cand = np.zeros((self._u_cap, chunk_ids.shape[1]),
+                             dtype=chunk_ids.dtype)
+        uniq_cand[:u] = chunk_ids[first_idx]
+        inv_dt = np.uint16 if self._u_cap <= 0x10000 else np.int32
+        return uniq_cand, inv.astype(inv_dt, copy=False)
+
     def _complete_global(self, handle) -> List[np.ndarray]:
         _tag, b, chunk_ids, words, dev_inputs, keys, bits, total, g = handle
         padded = chunk_ids.shape[0]
@@ -855,10 +921,15 @@ class PartitionedMatcher:
             if words is not None:
                 keys, bits, total = _compact_global(words, budget=g)
             else:
-                dev, ttok, tlen, tdollar = dev_inputs
-                keys, bits, total = _match_global(
-                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
-                )
+                dev, ttok, tlen, tdollar, grouped = dev_inputs
+                if grouped is None:
+                    keys, bits, total = _match_global(
+                        dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                    )
+                else:
+                    keys, bits, total = _match_global_grouped(
+                        dev, ttok, tlen, tdollar, *grouped, budget=g
+                    )
         keys = np.asarray(keys)[:n]
         bits = np.asarray(bits)[:n]
         return _decode_flat(keys, bits, chunk_ids, b, self.table._fid_of_row)

@@ -83,12 +83,13 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
     ]
+    lib.rt_enc_cache_put.restype = ctypes.c_int32
     lib.rt_enc_encode.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
     ]
     lib.rt_enc_encode.restype = ctypes.c_int64
     lib.rt_match_decode.argtypes = [
@@ -246,9 +247,13 @@ class NativeEncoder:
     def cache_clear(self) -> None:
         self._lib.rt_enc_cache_clear(self._ptr)
 
-    def cache_put(self, key: bytes, chunks: np.ndarray) -> None:
+    def cache_put(self, key: bytes, chunks: np.ndarray) -> int:
+        """→ the gid the native side assigned to this entry (authoritative —
+        no Python-side mirror counter to drift out of sync)."""
         chunks = np.ascontiguousarray(chunks, dtype=np.int32)
-        self._lib.rt_enc_cache_put(self._ptr, key, len(key), _i32p(chunks), len(chunks))
+        return self._lib.rt_enc_cache_put(
+            self._ptr, key, len(key), _i32p(chunks), len(chunks)
+        )
 
     def encode(
         self,
@@ -261,14 +266,16 @@ class NativeEncoder:
         nc_cap: int,
         cand: np.ndarray,
         cand_counts: np.ndarray,
+        group: np.ndarray,
     ) -> np.ndarray:
-        """Returns the indices of topics whose prefix key missed the cache."""
+        """Returns the indices of topics whose prefix key missed the cache;
+        ``group`` receives each topic's candidate-row gid (-1 on miss)."""
         miss = np.empty(n, dtype=np.int32)
         nmiss = self._lib.rt_enc_encode(
             self._ptr, blob, n, max_levels,
             _i32p(ttok), _i32p(tlen),
             tdollar.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            nc_cap, _i32p(cand), _i32p(cand_counts), _i32p(miss),
+            nc_cap, _i32p(cand), _i32p(cand_counts), _i32p(group), _i32p(miss),
         )
         return miss[:nmiss]
 

@@ -41,10 +41,16 @@ struct SvEq {
   bool operator()(std::string_view a, std::string_view b) const noexcept { return a == b; }
 };
 
+struct CacheEntry {
+  std::vector<int32_t> chunks;
+  int32_t gid;  // stable per-entry group id (dedup key for uploads)
+};
+
 struct Encoder {
   std::unordered_map<std::string, int32_t, SvHash, SvEq> tokens;
   // first-(<=3)-level topic prefix -> candidate chunk ids
-  std::unordered_map<std::string, std::vector<int32_t>, SvHash, SvEq> cand_cache;
+  std::unordered_map<std::string, CacheEntry, SvHash, SvEq> cand_cache;
+  int32_t next_gid = 0;
 };
 
 // Key = the raw topic bytes up to (not including) the third '/'. This is
@@ -70,24 +76,33 @@ void rt_enc_add_token(void* h, const char* s, int32_t len, int32_t id) {
   static_cast<Encoder*>(h)->tokens.emplace(std::string(s, static_cast<size_t>(len)), id);
 }
 
-void rt_enc_cache_clear(void* h) { static_cast<Encoder*>(h)->cand_cache.clear(); }
-
-void rt_enc_cache_put(void* h, const char* key, int32_t keylen, const int32_t* chunks,
-                      int32_t n) {
+void rt_enc_cache_clear(void* h) {
   auto* enc = static_cast<Encoder*>(h);
-  enc->cand_cache[std::string(key, static_cast<size_t>(keylen))] =
-      std::vector<int32_t>(chunks, chunks + n);
+  enc->cand_cache.clear();
+  enc->next_gid = 0;
+}
+
+int32_t rt_enc_cache_put(void* h, const char* key, int32_t keylen,
+                         const int32_t* chunks, int32_t n) {
+  auto* enc = static_cast<Encoder*>(h);
+  auto& e = enc->cand_cache[std::string(key, static_cast<size_t>(keylen))];
+  e.chunks.assign(chunks, chunks + n);
+  e.gid = enc->next_gid++;
+  return e.gid;  // the authoritative gid — callers must not mirror-count
 }
 
 // Encode n '\0'-separated topics. Fills ttok [n, max_levels] (PAD beyond the
 // topic's levels), tlen [n] (full level count), tdollar [n], and for topics
 // whose prefix key is cached: cand [n, nc_cap] (0-padded) + cand_counts [n]
-// (the TRUE count, even when > nc_cap — caller grows nc_cap and retries).
-// Topics with an uncached prefix get cand_counts[j] = -1 and their index
-// appended to miss_idx. Returns the number of misses.
+// (the TRUE count, even when > nc_cap — caller grows nc_cap and retries) +
+// group [n] (the cache entry's stable gid — identical candidate rows share
+// a gid, letting the caller upload each distinct row once).
+// Topics with an uncached prefix get cand_counts[j] = group[j] = -1 and
+// their index appended to miss_idx. Returns the number of misses.
 int64_t rt_enc_encode(void* h, const char* blob, int64_t n, int32_t max_levels,
                       int32_t* ttok, int32_t* tlen, uint8_t* tdollar, int32_t nc_cap,
-                      int32_t* cand, int32_t* cand_counts, int32_t* miss_idx) {
+                      int32_t* cand, int32_t* cand_counts, int32_t* group,
+                      int32_t* miss_idx) {
   auto* enc = static_cast<Encoder*>(h);
   const auto& tokens = enc->tokens;
   const auto& cache = enc->cand_cache;
@@ -117,11 +132,13 @@ int64_t rt_enc_encode(void* h, const char* blob, int64_t n, int32_t max_levels,
     auto it = cache.find(prefix_key(topic));
     if (it == cache.end()) {
       cand_counts[j] = -1;
+      group[j] = -1;
       miss_idx[misses++] = static_cast<int32_t>(j);
     } else {
-      const auto& chunks = it->second;
+      const auto& chunks = it->second.chunks;
       int32_t c = static_cast<int32_t>(chunks.size());
       cand_counts[j] = c;
+      group[j] = it->second.gid;
       int32_t w = c < nc_cap ? c : nc_cap;
       int32_t* out = cand + j * nc_cap;
       std::memcpy(out, chunks.data(), static_cast<size_t>(w) * sizeof(int32_t));

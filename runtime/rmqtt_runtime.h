@@ -23,11 +23,12 @@ void* rt_enc_new();
 void rt_enc_free(void* enc);
 void rt_enc_add_token(void* enc, const char* s, int32_t len, int32_t id);
 void rt_enc_cache_clear(void* enc);
-void rt_enc_cache_put(void* enc, const char* key, int32_t keylen,
-                      const int32_t* chunks, int32_t n);
+int32_t rt_enc_cache_put(void* enc, const char* key, int32_t keylen,
+                         const int32_t* chunks, int32_t n);
 int64_t rt_enc_encode(void* enc, const char* blob, int64_t n, int32_t max_levels,
                       int32_t* ttok, int32_t* tlen, uint8_t* tdollar, int32_t nc_cap,
-                      int32_t* cand, int32_t* cand_counts, int32_t* miss_idx);
+                      int32_t* cand, int32_t* cand_counts, int32_t* group,
+                      int32_t* miss_idx);
 int64_t rt_match_decode(const int32_t* wi, const uint32_t* wb, int64_t b,
                         int64_t k, const int32_t* chunk_ids, int64_t nc,
                         int32_t wpc, int32_t chunk, const int64_t* fid_map,

@@ -60,14 +60,15 @@ static void test_encoder() {
   blob.push_back('\0');
   const int64_t n = 3;
   const int32_t lvl = 8, cap = 4;
-  std::vector<int32_t> ttok(n * lvl), tlen(n), cand(n * cap), cnt(n), miss(n);
+  std::vector<int32_t> ttok(n * lvl), tlen(n), cand(n * cap), cnt(n), grp(n), miss(n);
   std::vector<uint8_t> dollar(n);
   int64_t misses = rt_enc_encode(e, blob.data(), n, lvl, ttok.data(), tlen.data(),
                                  dollar.data(), cap, cand.data(), cnt.data(),
-                                 miss.data());
+                                 grp.data(), miss.data());
   assert(misses == 2);
   assert(tlen[0] == 5 && cnt[0] == 3);
   assert(ttok[0] == 10);
+  assert(grp[0] == 0 && grp[1] == -1 && grp[2] == -1);  // gid of the put entry
   rt_enc_cache_clear(e);
   rt_enc_free(e);
 }

@@ -398,3 +398,23 @@ def test_hostile_topic_depth_clamped():
     deep = "a/" + "/".join(str(i) for i in range(40000))
     (row,) = m.match([deep])
     assert row.tolist() == sorted([f_hash, f_pfx]) and f_exact not in row.tolist()
+
+
+def test_grouped_upload_dedup_parity():
+    """A batch of repeated topics (live-traffic shape: U collapses) goes
+    through the grouped candidate upload and routes identically to distinct
+    topics; the no-dedup gate keeps unique batches on the plain path."""
+    table, fids, rng = build_random(53, 1500)
+    m = PartitionedMatcher(table, compact="global")
+    hot = ["a/b/c", "a/b", "x/y/z"]
+    topics = [hot[i % 3] for i in range(64)]  # U=3 << B
+    rows = m.match(topics)
+    for topic, row in zip(topics, rows):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert row.tolist() == expect, topic
+    # gate: mostly-unique batch must return None from _group_inputs
+    import numpy as np
+
+    uniq_groups = np.arange(64, dtype=np.int32)
+    fake_cand = np.zeros((64, 4), dtype=np.uint16)
+    assert m._group_inputs(uniq_groups, fake_cand) is None
