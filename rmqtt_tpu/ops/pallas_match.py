@@ -65,13 +65,16 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
             beyond = (
                 lax.broadcasted_iota(jnp.int32, (chunk, lvl), 1) >= plen[:, None]
             )
-            prefix_ok = jnp.all(eq | plus | beyond, axis=1)  # [CHUNK]
+            # Mosaic cannot lower boolean lane reductions (jnp.all widens
+            # i1->i8 and truncates back, an unsupported trunci) — count the
+            # failing levels in int32 instead
+            bad = jnp.sum(jnp.where(eq | plus | beyond, 0, 1), axis=1)  # [CHUNK]
             hh = (flags & 1) != 0
             fw = (flags & 2) != 0
             tl = tlen_ref[t, 0]
             len_ok = jnp.where(hh, tl >= plen, tl == flen)
             dollar_ok = jnp.logical_not((tdollar_ref[t, 0] != 0) & fw)
-            m = prefix_ok & len_ok & dollar_ok
+            m32 = jnp.where((bad == 0) & len_ok & dollar_ok, 1, 0)
             # Mosaic has no unsigned reductions: pack bits via an int32 sum
             # (distinct powers of two -> wrap-exact two's complement) and
             # bitcast the packed words to uint32
@@ -80,7 +83,7 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
                 lax.broadcasted_iota(jnp.int32, (wpc, 32), 1),
             )
             words = jnp.sum(
-                m.reshape(wpc, 32).astype(jnp.int32) * bit, axis=1,
+                m32.reshape(wpc, 32) * bit, axis=1,
                 dtype=jnp.int32,
             )
             out_ref[pl.ds(t, 1), pl.ds(k * wpc, wpc)] = lax.bitcast_convert_type(

@@ -619,41 +619,64 @@ def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
 
 
 def compact_global_impl(words, budget: int):
-    """Packed words [B, W] → batch-global nonzero compaction.
+    """Packed words [B, W] → batch-global ROUTE-level compaction.
 
     Per-topic ``top_k`` (below) must fetch ``max_words`` slots for EVERY
     topic to cover the worst one — measured 32 slots against a batch
     average of ~6 nonzero words at 1M subs, so >80% of the device→host
     transfer (the tunnel-measured wall, scripts/tpu_profile.py) is padding.
-    Here the whole batch shares one ``budget`` of slots: an exclusive
-    prefix sum over the nonzero mask assigns each nonzero word a slot, and
-    a disjoint scatter packs (flat word key, bits) arrays. Keys are flat
-    ``b*W + w`` indices, ascending (topic-major) by construction, so the
-    decoder needs no sort by topic. Overflow (total > budget) drops
-    entries on-device; the caller re-runs with a wider sticky budget.
+    And the measured word occupancy is ~1.12 set bits, so even compacted
+    (key, bits) words cost ~7 bytes per route. Here the whole batch shares
+    one ``budget`` of per-ROUTE slots, filled in two stages:
 
-    → (keys [budget] uint32, bits [budget] uint32, total int32)
+    1. word compaction — an exclusive prefix sum over the nonzero-word
+       mask assigns each nonzero word a slot; disjoint scatters pack
+       (word-index-within-topic, bits) into budget-sized arrays;
+    2. route expansion — only the COMPACTED words ([budget, 32] bit
+       matrix, ~33 MB at the measured budgets, vs [B, W, 32] for the raw
+       batch) are expanded bit-wise; a second prefix sum packs one
+       ``widx*32 + bitpos`` uint16 per set bit.
+
+    Slot order is flat (topic-major, then word, then bit) by
+    construction, so per-topic route counts are enough to reattribute
+    slots on the host: the wire is 2 bytes per route + 2 per topic —
+    ~3.8x less device→host transfer than the (key, bits) format at the
+    measured match rates. Overflow (cnts.sum() > budget) drops entries
+    on-device; the caller re-runs with a wider sticky budget (route
+    count >= word count, so one check covers both stages).
+
+    → (routes [budget] uint16|uint32, cnts [B] uint16)
     """
     b, w = words.shape
     flat = words.ravel()
     nz = flat != jnp.uint32(0)
     nzi = nz.astype(jnp.int32)
     pos = jnp.cumsum(nzi) - nzi  # exclusive prefix sum
-    total = pos[-1] + nzi[-1]
     # non-nz (and overflow) slots land at index==budget → dropped. The
     # sentinel index is duplicated across every zero word, so this scatter
     # must NOT claim unique_indices (implementation-defined corruption on
     # backends that exploit the flag before dropping OOB updates).
     idx = jnp.where(nz & (pos < budget), pos, budget)
-    keys = jnp.zeros((budget,), jnp.uint32).at[idx].set(
-        jnp.arange(b * w, dtype=jnp.uint32), mode="drop"
-    )
+    wsrc = lax.broadcasted_iota(jnp.int32, (b, w), 1).ravel()
+    widx = jnp.zeros((budget,), jnp.int32).at[idx].set(wsrc, mode="drop")
     bits = jnp.zeros((budget,), jnp.uint32).at[idx].set(flat, mode="drop")
-    return keys, bits, total
+    # stage 2: expand the compacted words' bits into route slots
+    bitm = (bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    rnzi = bitm.astype(jnp.int32).ravel()  # [budget*32]
+    rpos = jnp.cumsum(rnzi) - rnzi
+    ridx = jnp.where((rnzi > 0) & (rpos < budget), rpos, budget)
+    rdt = jnp.uint16 if w * 32 <= 0x10000 else jnp.uint32
+    rval = (
+        widx[:, None] * 32 + jnp.arange(32, dtype=jnp.int32)
+    ).ravel().astype(rdt)
+    routes = jnp.zeros((budget,), rdt).at[ridx].set(rval, mode="drop")
+    cnts = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=1)
+    cdt = jnp.uint16 if w * 32 < 0x10000 else jnp.int32  # count <= w*32
+    return routes, cnts.astype(cdt)
 
 
 def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int):
-    """Gather-based partitioned match → global-compact (keys, bits, total)."""
+    """Gather-based partitioned match → global-compact (routes, cnts)."""
     words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
     return compact_global_impl(words, budget)
 
@@ -839,22 +862,22 @@ class PartitionedMatcher:
                 g = max(256, 1 << (4 * padded - 1).bit_length())
                 self._budgets[padded] = g
             if words is not None:
-                keys, bits, total = _compact_global(words, budget=g)
+                routes, cnts = _compact_global(words, budget=g)
                 grouped = None
             else:
                 grouped = self._group_inputs(enc[5], chunk_ids)
                 if grouped is None:  # batch doesn't dedup; plain upload
-                    keys, bits, total = _match_global(
+                    routes, cnts = _match_global(
                         dev, ttok, tlen, tdollar, chunk_ids, budget=g
                     )
                 else:
-                    keys, bits, total = _match_global_grouped(
+                    routes, cnts = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
             # the handle carries ITS OWN budget: a sticky widening by a later
             # handle must not mask this one's truncation
             return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
-                    keys, bits, total, g)
+                    routes, cnts, g)
         wi, wb, cn = (
             _compact_words(words, max_words=self.max_words)
             if words is not None
@@ -909,30 +932,31 @@ class PartitionedMatcher:
         return uniq_cand, inv.astype(inv_dt, copy=False)
 
     def _complete_global(self, handle) -> List[np.ndarray]:
-        _tag, b, chunk_ids, words, dev_inputs, keys, bits, total, g = handle
+        _tag, b, chunk_ids, words, dev_inputs, routes, cnts, g = handle
         padded = chunk_ids.shape[0]
         while True:
-            n = int(total)  # total is exact even when the scatter truncated
+            cn = np.asarray(cnts, dtype=np.int64)  # counts are truncation-exact
+            n = int(cn.sum())
             if n <= g:
                 break
             g = 1 << max(8, (n - 1).bit_length())
             # sticky pow2 regrow for this batch size
             self._budgets[padded] = max(self._budgets.get(padded, 0), g)
             if words is not None:
-                keys, bits, total = _compact_global(words, budget=g)
+                routes, cnts = _compact_global(words, budget=g)
             else:
                 dev, ttok, tlen, tdollar, grouped = dev_inputs
                 if grouped is None:
-                    keys, bits, total = _match_global(
+                    routes, cnts = _match_global(
                         dev, ttok, tlen, tdollar, chunk_ids, budget=g
                     )
                 else:
-                    keys, bits, total = _match_global_grouped(
+                    routes, cnts = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
-        keys = np.asarray(keys)[:n]
-        bits = np.asarray(bits)[:n]
-        return _decode_flat(keys, bits, chunk_ids, b, self.table._fid_of_row)
+        return _decode_routes(
+            np.asarray(routes)[:n], cn, chunk_ids, b, self.table._fid_of_row
+        )
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         return self.match_complete(self.match_submit(topics, pad_to_pow2))
@@ -972,54 +996,60 @@ def _native_decode(wi, wb, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
     return np.split(flat, bounds)
 
 
-def _decode_flat(
-    keys: np.ndarray, bits: np.ndarray, chunk_ids: np.ndarray, b: int,
+def _decode_routes(
+    routes: np.ndarray, cn: np.ndarray, chunk_ids: np.ndarray, b: int,
     fid_map: np.ndarray,
 ) -> List[np.ndarray]:
-    """Global-compaction (keys, bits) → per-topic sorted fid arrays.
+    """Route-level global compaction → per-topic sorted fid arrays.
 
-    ``keys`` are flat ``t*W + w`` word indices, ascending (topic-major) by
-    the prefix-sum construction. Native path in runtime/encode.cc
-    (rt_match_decode_flat); numpy fallback doubles as its oracle."""
-    native = _native_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    ``routes`` carries one ``widx*32 + bitpos`` entry per match, flat
+    topic-major by the two-stage prefix-sum construction; ``cn`` is the
+    per-(padded-)topic route count vector, which reattributes slots to
+    topics. Native path in runtime/encode.cc (rt_match_decode_routes:
+    fid map + per-topic sort); the numpy fallback doubles as its
+    differential oracle, where the composite-key sort in
+    ``_group_sorted`` dominates (~10ms/200K routes)."""
+    native = _native_decode_routes(routes, cn, chunk_ids, b, fid_map)
     if native is not None:
         return native
-    return _numpy_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    return _numpy_decode_routes(routes, cn, chunk_ids, b, fid_map)
 
 
-def _native_decode_flat(keys, bits, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
+def _native_decode_routes(routes, cn, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
     try:
         from rmqtt_tpu import runtime as rt
     except Exception:
         return None
-    res = rt.match_decode_flat(
-        np.ascontiguousarray(keys, dtype=np.uint32),
-        np.ascontiguousarray(bits, dtype=np.uint32),
+    flat = rt.match_decode_routes(
+        np.ascontiguousarray(routes, dtype=np.uint32),
+        np.ascontiguousarray(cn, dtype=np.int64),
         np.ascontiguousarray(chunk_ids, dtype=np.int32),
         b, WORDS_PER_CHUNK, CHUNK, fid_map,
     )
-    if res is None:
+    if flat is None:
         return None
-    flat, counts = res
-    bounds = np.cumsum(counts[:-1])
+    bounds = np.cumsum(cn[: b - 1])
     return np.split(flat, bounds)
 
 
-def _numpy_decode_flat(
-    keys: np.ndarray, bits: np.ndarray, chunk_ids: np.ndarray, b: int,
+def _numpy_decode_routes(
+    routes: np.ndarray, cn: np.ndarray, chunk_ids: np.ndarray, b: int,
     fid_map: np.ndarray,
 ) -> List[np.ndarray]:
     wpc = WORDS_PER_CHUNK
-    w_total = chunk_ids.shape[1] * wpc
-    bitpos = (bits[:, None] >> np.arange(32, dtype=np.uint32)) & np.uint32(1)
-    nz_i, cols = np.nonzero(bitpos)
-    key = keys[nz_i]
-    tj = (key // w_total).astype(np.int64)
-    widx = (key % w_total).astype(np.int64)
+    padded = chunk_ids.shape[0]
+    if cn[b:].any():
+        # same fail-loudly contract as the native decoder: a padded topic
+        # (tlen=-2, can match nothing) with a nonzero count is a device/
+        # compaction bug — never misattribute its routes to topic b-1
+        raise AssertionError("padded topic produced routes — device bug")
+    tj = np.repeat(np.arange(padded, dtype=np.int64), cn)
+    r = routes.astype(np.int64, copy=False)
+    widx = r >> 5
     rows = (
         chunk_ids[tj, widx // wpc].astype(np.int64) * CHUNK
         + (widx % wpc) * 32
-        + cols
+        + (r & 31)
     )
     fids = fid_map[rows]
     return _group_sorted(tj, fids, b)

@@ -130,8 +130,10 @@ class ShardedPartitionedMatcher:
         self.max_words = max_words
         # same two modes as the local PartitionedMatcher: 'global' compacts
         # per DEVICE (each shard prefix-sums its own topic slice into its
-        # own slot budget; keys offset by shard index stay globally
-        # topic-major), 'topk' is the per-topic fixed-width fallback
+        # own slot budget and returns topic-local route slots + per-topic
+        # counts; shard-major == topic-major, so the host reattributes
+        # globally from the concatenated counts), 'topk' is the per-topic
+        # fixed-width fallback
         self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
         self._budgets = {}  # padded batch size -> sticky pow2 PER-DEVICE slots
         self._gsteps = {}  # per-device budget -> jitted shard_map step
@@ -144,23 +146,21 @@ class ShardedPartitionedMatcher:
             return step
         from rmqtt_tpu.ops.partitioned import compact_global_impl, scan_words_impl
 
-        fp = self.mesh.shape["fp"]
         axes = ("dp", "fp")
 
         @functools.partial(
             jax.shard_map,
             mesh=self.mesh,
             in_specs=(P(), P(axes, None), P(axes), P(axes), P(axes, None)),
-            out_specs=(P(axes), P(axes), P(axes)),
+            out_specs=(P(axes), P(axes)),
         )
         def gstep(rows, ttok, tlen, td, cids):
             words = scan_words_impl(rows, ttok, tlen, td, cids)
-            keys, bits, total = compact_global_impl(words, budget_per_dev)
-            shard = lax.axis_index("dp") * fp + lax.axis_index("fp")
-            bl, w = words.shape
-            # rebase local flat keys to the global topic index space
-            keys = keys + jnp.uint32(shard * bl * w)
-            return keys, bits, total[None]
+            # routes are topic-LOCAL (widx*32+bitpos) and cnts is the shard's
+            # per-topic count vector — shard-major == topic-major, so the
+            # host reattributes slots from the concatenated counts
+            routes, cnts = compact_global_impl(words, budget_per_dev)
+            return routes, cnts
 
         step = jax.jit(gstep)
         self._gsteps[budget_per_dev] = step
@@ -209,27 +209,26 @@ class ShardedPartitionedMatcher:
         return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
 
     def _match_global(self, dev, inputs, chunk_ids, b: int, padded: int) -> list:
-        from rmqtt_tpu.ops.partitioned import _decode_flat
+        from rmqtt_tpu.ops.partitioned import _decode_routes
 
         gd = self._budgets.get(padded)
         if gd is None:
             gd = max(256, 1 << (4 * (padded // self.ndev) - 1).bit_length())
             self._budgets[padded] = gd
         while True:
-            keys, bits, totals = self._global_step(gd)(dev, *inputs)
-            totals = np.asarray(totals)
+            routes, cnts = self._global_step(gd)(dev, *inputs)
+            cn = np.asarray(cnts, dtype=np.int64)  # [padded], shard-major
+            totals = cn.reshape(self.ndev, -1).sum(axis=1)
             mx = int(totals.max(initial=0))
             if mx <= gd:
                 break
             # a shard overflowed its slice: regrow (sticky) and re-run
             gd = 1 << max(8, (mx - 1).bit_length())
             self._budgets[padded] = max(self._budgets[padded], gd)
-        keys, bits = np.asarray(keys), np.asarray(bits)
-        # concatenate each shard's valid prefix; keys are already rebased to
-        # the global topic space and shard-major == topic-major
-        parts_k = [keys[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
-        parts_b = [bits[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
-        return _decode_flat(
-            np.concatenate(parts_k), np.concatenate(parts_b),
-            chunk_ids[:b], b, self.table._fid_of_row,
+        routes = np.asarray(routes)
+        # concatenate each shard's valid prefix; shard-major == topic-major,
+        # so the concatenated counts reattribute slots globally
+        parts = [routes[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
+        return _decode_routes(
+            np.concatenate(parts), cn, chunk_ids, b, self.table._fid_of_row,
         )

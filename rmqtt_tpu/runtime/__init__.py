@@ -102,16 +102,14 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int64),
     ]
     lib.rt_match_decode.restype = ctypes.c_int64
-    lib.rt_match_decode_flat.argtypes = [
-        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-        ctypes.c_int64,
+    lib.rt_match_decode_routes.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int32, ctypes.c_int32,
-        ctypes.POINTER(ctypes.c_int64),
-        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
     ]
-    lib.rt_match_decode_flat.restype = ctypes.c_int64
+    lib.rt_match_decode_routes.restype = ctypes.c_int64
     lib.rt_codec_scan.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
@@ -280,38 +278,35 @@ class NativeEncoder:
         return miss[:nmiss]
 
 
-def match_decode_flat(keys: np.ndarray, bits: np.ndarray, chunk_ids: np.ndarray,
-                      b: int, wpc: int, chunk: int, fid_map: np.ndarray):
-    """Native batch-global (keys, bits) → (flat sorted fids, per-topic
-    counts); None if the runtime is unavailable. keys/bits uint32,
-    chunk_ids int32, fid_map int64, all C-contiguous."""
+def match_decode_routes(routes: np.ndarray, counts: np.ndarray,
+                        chunk_ids: np.ndarray, b: int, wpc: int, chunk: int,
+                        fid_map: np.ndarray):
+    """Native route-level global compaction → flat per-topic-sorted fids;
+    None if the runtime is unavailable. routes uint32, counts int64 (per
+    PADDED topic), chunk_ids int32, fid_map int64, all C-contiguous. The
+    route total is known up front (= counts.sum() = len(routes)), so
+    unlike the word decoders there is no two-pass cap dance."""
     lib = load()
     if lib is None:
         return None
-    n = int(keys.shape[0])
-    nc = chunk_ids.shape[1]
+    bp, nc = chunk_ids.shape
     fid_map = np.ascontiguousarray(fid_map, dtype=np.int64)
-    counts = np.empty(b, dtype=np.int64)
-    cap = max(64, n * 4)
     i32 = ctypes.POINTER(ctypes.c_int32)
     i64 = ctypes.POINTER(ctypes.c_int64)
     u32 = ctypes.POINTER(ctypes.c_uint32)
-    while True:
-        out = np.empty(cap, dtype=np.int64)
-        total = lib.rt_match_decode_flat(
-            keys.ctypes.data_as(u32), bits.ctypes.data_as(u32), n,
-            chunk_ids.ctypes.data_as(i32), b, nc, wpc, chunk,
-            fid_map.ctypes.data_as(i64),
-            out.ctypes.data_as(i64), cap, counts.ctypes.data_as(i64),
+    n = int(routes.shape[0])
+    out = np.empty(n, dtype=np.int64)
+    total = lib.rt_match_decode_routes(
+        routes.ctypes.data_as(u32), n, counts.ctypes.data_as(i64),
+        chunk_ids.ctypes.data_as(i32), b, bp, nc, wpc, chunk,
+        fid_map.ctypes.data_as(i64), out.ctypes.data_as(i64),
+    )
+    if total < 0:
+        raise AssertionError(
+            "rt_match_decode_routes hit an out-of-range route/fid/count — "
+            "kernel/compaction bug"
         )
-        if total < 0:
-            raise AssertionError(
-                "rt_match_decode_flat hit an out-of-range key/fid — "
-                "kernel/compaction bug"
-            )
-        if total <= cap:
-            return out[:total], counts
-        cap = int(total)
+    return out
 
 
 def match_decode(wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray,

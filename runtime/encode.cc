@@ -204,50 +204,53 @@ int64_t rt_match_decode(const int32_t* wi, const uint32_t* wb, int64_t b,
   return total;
 }
 
-// Decode the batch-GLOBAL compaction (ops/partitioned.py
-// compact_global_impl): n (key, bits) entries, keys = flat t*W + w word
-// indices ascending (topic-major by the device prefix-sum), W = nc*wpc.
-// Same two-pass contract as rt_match_decode: counts[b] always filled,
-// fids written only when total fits cap; -1 on a bad fid.
-int64_t rt_match_decode_flat(const uint32_t* keys, const uint32_t* bits,
-                             int64_t n, const int32_t* chunk_ids, int64_t b,
-                             int64_t nc, int32_t wpc, int32_t chunk,
-                             const int64_t* fid_map, int64_t* out_fids,
-                             int64_t cap, int64_t* counts) {
+// Decode the ROUTE-level batch-global compaction (ops/partitioned.py
+// compact_global_impl): one widx*32+bitpos entry per match, flat
+// topic-major by the device's two-stage prefix sum; counts[bp] (per
+// padded-topic route counts, fetched with the routes) reattributes the
+// slots. For entry r of topic t the matched row is
+// chunk_ids[t, (r>>5)/wpc]*chunk + ((r>>5)%wpc)*32 + (r&31), mapped
+// through fid_map and sorted per topic. Writes nothing past b real
+// topics — a nonzero count there is a device/compaction bug (padded
+// topics encode tlen=-2 and can match nothing). Returns the total route
+// count, or -1 on any out-of-range widx/fid/count.
+int64_t rt_match_decode_routes(const uint32_t* routes, int64_t n,
+                               const int64_t* counts,
+                               const int32_t* chunk_ids, int64_t b,
+                               int64_t bp, int64_t nc, int32_t wpc,
+                               int32_t chunk, const int64_t* fid_map,
+                               int64_t* out_fids) {
   const int64_t w_total = nc * wpc;
-  for (int64_t t = 0; t < b; ++t) counts[t] = 0;
-  int64_t total = 0;
-  for (int64_t e = 0; e < n; ++e) {
-    const int64_t t = keys[e] / w_total;
-    if (t >= b) return -1;  // key out of range: device/compaction bug
-    const int64_t c = __builtin_popcount(bits[e]);
-    counts[t] += c;
-    total += c;
-  }
-  if (total > cap) return total;
+  for (int64_t t = b; t < bp; ++t)
+    if (counts[t] != 0) return -1;  // padded topic matched: device bug
   int64_t off = 0;
-  int64_t e = 0;
-  for (int64_t t = 0; t < b && e < n; ++t) {
-    if (counts[t] == 0) continue;
+  for (int64_t t = 0; t < b; ++t) {
+    const int64_t c = counts[t];
+    if (c == 0) continue;
+    // counts must stay consistent with the fetched routes buffer (and
+    // out_fids, allocated at n): a negative or overrunning count is a
+    // device/caller bug and must fail loudly, not read heap garbage
+    if (c < 0 || off + c > n) return -1;
     int64_t* span = out_fids + off;
-    int64_t w = 0;
     const int32_t* crow = chunk_ids + t * nc;
-    while (e < n && static_cast<int64_t>(keys[e]) / w_total == t) {
-      const int64_t widx = keys[e] % w_total;
-      const int64_t base =
-          static_cast<int64_t>(crow[widx / wpc]) * chunk + (widx % wpc) * 32;
-      uint32_t bb = bits[e];
-      while (bb) {
-        const int bit = __builtin_ctz(bb);
-        bb &= bb - 1;
-        const int64_t fid = fid_map[base + bit];
-        if (fid < 0 || fid >= (1LL << 32)) return -1;
-        span[w++] = fid;
+    const uint32_t* rs = routes + off;
+    for (int64_t i = 0; i < c; ++i) {
+      const uint32_t r = rs[i];
+      const int64_t widx = r >> 5;
+      if (widx >= w_total) return -1;  // route out of range: device bug
+      const int64_t fid =
+          fid_map[static_cast<int64_t>(crow[widx / wpc]) * chunk +
+                  (widx % wpc) * 32 + (r & 31)];
+      if (fid < 0 || fid >= (1LL << 32)) {
+        // cleared-row sentinel (-1) or overflow: a kernel/compaction bug
+        // must fail loudly (same contract as the numpy oracle), never
+        // hand a bogus subscriber id to delivery
+        return -1;
       }
-      ++e;
+      span[i] = fid;
     }
-    std::sort(span, span + w);
-    off += w;
+    std::sort(span, span + c);
+    off += c;
   }
-  return total;
+  return off;
 }

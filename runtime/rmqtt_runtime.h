@@ -33,11 +33,12 @@ int64_t rt_match_decode(const int32_t* wi, const uint32_t* wb, int64_t b,
                         int64_t k, const int32_t* chunk_ids, int64_t nc,
                         int32_t wpc, int32_t chunk, const int64_t* fid_map,
                         int64_t* out_fids, int64_t cap, int64_t* counts);
-int64_t rt_match_decode_flat(const uint32_t* keys, const uint32_t* bits,
-                             int64_t n, const int32_t* chunk_ids, int64_t b,
-                             int64_t nc, int32_t wpc, int32_t chunk,
-                             const int64_t* fid_map, int64_t* out_fids,
-                             int64_t cap, int64_t* counts);
+int64_t rt_match_decode_routes(const uint32_t* routes, int64_t n,
+                               const int64_t* counts,
+                               const int32_t* chunk_ids, int64_t b,
+                               int64_t bp, int64_t nc, int32_t wpc,
+                               int32_t chunk, const int64_t* fid_map,
+                               int64_t* out_fids);
 
 // codec.cc — MQTT frame scanner + topic validation
 int64_t rt_codec_scan(const uint8_t* buf, int64_t len, int32_t is_v5,

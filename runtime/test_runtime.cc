@@ -101,37 +101,48 @@ static void test_match_decode() {
   assert(total == -1);
 }
 
-static void test_match_decode_flat() {
-  // batch-global entries, b=2, nc=2, wpc=4 (W=8), chunk=128
-  // topic 0: word 0 (chunk 1, bits 0,1) + word 5 (chunk 2, +32+31)
-  // topic 1: word 8+1 (chunk 2, +32)
-  uint32_t keys[3] = {0, 5, 9};
-  uint32_t bits[3] = {0x3u, 0x80000000u, 0x1u};
-  int32_t chunk_ids[4] = {1, 2, 2, 0};
+static void test_match_decode_routes() {
+  // route-level entries, b=2 (bp=3 with one padded topic), nc=2, wpc=4
+  // (W=8), chunk=128
+  // topic 0: word 0 bits 0,1 (chunk 1) + word 5 bit 31 (chunk 2, +32+31)
+  // topic 1: word 1 bit 0 (chunk 2, +32)
+  uint32_t routes[4] = {0 * 32 + 0, 0 * 32 + 1, 5 * 32 + 31, 1 * 32 + 0};
+  int64_t counts[3] = {3, 1, 0};
+  int32_t chunk_ids[6] = {1, 2, 2, 0, 0, 0};
   std::vector<int64_t> fid_map(3 * 128);
   for (size_t i = 0; i < fid_map.size(); ++i) fid_map[i] = 1000 + (int64_t)i;
   int64_t out[16];
-  int64_t counts[2];
-  int64_t total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
-                                       fid_map.data(), out, 16, counts);
-  assert(total == 4 && counts[0] == 3 && counts[1] == 1);
+  int64_t total = rt_match_decode_routes(routes, 4, counts, chunk_ids, 2, 3, 2,
+                                         4, 128, fid_map.data(), out);
+  assert(total == 4);
   assert(out[0] == 1000 + 128 && out[1] == 1000 + 129);
   assert(out[2] == 1000 + 2 * 128 + 32 + 31);
   assert(out[3] == 1000 + 2 * 128 + 32);
-  // overflow: counts filled, nothing written past cap
-  int64_t tiny[1];
-  total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
-                               fid_map.data(), tiny, 1, counts);
-  assert(total == 4 && counts[0] == 3);
-  // out-of-range key (topic index >= b) fails loudly
-  uint32_t bad_keys[1] = {16};  // t = 16/8 = 2 >= b=2
-  total = rt_match_decode_flat(bad_keys, bits, 1, chunk_ids, 2, 2, 4, 128,
-                               fid_map.data(), out, 16, counts);
+  // a padded topic with a nonzero count fails loudly (device bug)
+  int64_t bad_counts[3] = {3, 0, 1};
+  total = rt_match_decode_routes(routes, 4, bad_counts, chunk_ids, 2, 3, 2, 4,
+                                 128, fid_map.data(), out);
+  assert(total == -1);
+  // counts overrunning the routes buffer fail loudly (caller bug)
+  int64_t over_counts[3] = {3, 2, 0};
+  total = rt_match_decode_routes(routes, 4, over_counts, chunk_ids, 2, 3, 2, 4,
+                                 128, fid_map.data(), out);
+  assert(total == -1);
+  // a negative count fails loudly (would be UB in the sort)
+  int64_t neg_counts[3] = {-1, 1, 0};
+  total = rt_match_decode_routes(routes, 4, neg_counts, chunk_ids, 2, 3, 2, 4,
+                                 128, fid_map.data(), out);
+  assert(total == -1);
+  // out-of-range route (widx >= W) fails loudly
+  uint32_t bad_routes[1] = {8 * 32};
+  int64_t one[3] = {1, 0, 0};
+  total = rt_match_decode_routes(bad_routes, 1, one, chunk_ids, 2, 3, 2, 4,
+                                 128, fid_map.data(), out);
   assert(total == -1);
   // cleared-row sentinel fails loudly
   fid_map[128] = -1;
-  total = rt_match_decode_flat(keys, bits, 3, chunk_ids, 2, 2, 4, 128,
-                               fid_map.data(), out, 16, counts);
+  total = rt_match_decode_routes(routes, 4, counts, chunk_ids, 2, 3, 2, 4, 128,
+                                 fid_map.data(), out);
   assert(total == -1);
 }
 
@@ -176,7 +187,7 @@ int main() {
   test_trie();
   test_encoder();
   test_match_decode();
-  test_match_decode_flat();
+  test_match_decode_routes();
   test_codec();
   std::puts("runtime sanitizer checks passed");
   return 0;

@@ -134,21 +134,25 @@ def main():
 
     def run_global():
         h = mg.match_submit(batch, pad_to_pow2=False)
-        (_tag, _b, _cids, _words, _devin, keys, bits, total, budget) = h
-        n = int(total)
+        (_tag, _b, _cids, _words, _devin, routes, cnts, budget) = h
+        cnts = np.asarray(cnts)
+        n = int(cnts.astype(np.int64).sum())
         assert n <= budget, f"budget overflow mid-profile ({n} > {budget})"
-        return np.asarray(keys), np.asarray(bits), n
+        return np.asarray(routes), cnts
 
-    gfull_t, (keys, bits, total) = timed(run_global, n=args.rounds)
-    from rmqtt_tpu.ops.partitioned import _decode_flat
+    gfull_t, (groutes, gcnts) = timed(run_global, n=args.rounds)
+    from rmqtt_tpu.ops.partitioned import _decode_routes
 
-    gdec_t, grows = timed(lambda: _decode_flat(keys[:total], bits[:total],
-                                               chunk_ids, b,
-                                               table._fid_of_row), n=args.rounds)
-    gbytes = keys.nbytes + bits.nbytes
+    gcn = gcnts.astype(np.int64)
+    total = int(gcn.sum())
+    gdec_t, grows = timed(lambda: _decode_routes(groutes[:total], gcn,
+                                                 chunk_ids, b,
+                                                 table._fid_of_row), n=args.rounds)
+    gbytes = groutes.nbytes + gcnts.nbytes
     print(f"global: budget={g} total={total} fetch {gfull_t * 1e3:.1f} ms "
           f"({gbytes / 1e6:.2f} MB) decode {gdec_t * 1e3:.1f} ms "
           f"(routes: {sum(len(r) for r in grows)})")
+    sys.stdout.flush()
 
     if args.skip_sweep:
         return

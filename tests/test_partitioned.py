@@ -334,17 +334,17 @@ def test_global_budget_regrow():
     assert row.tolist() == expect
 
 
-def test_flat_decode_native_matches_numpy():
-    """rt_match_decode_flat (C++) vs the numpy flat-decode oracle on random
-    global-compaction entries."""
+def test_routes_decode_native_matches_numpy():
+    """rt_match_decode_routes (C++) vs the numpy route-decode oracle on
+    random route-level global-compaction entries (incl. padded topics)."""
     import numpy as np
 
     from rmqtt_tpu import runtime as rt
     from rmqtt_tpu.ops.partitioned import (
         CHUNK,
         WORDS_PER_CHUNK,
-        _native_decode_flat,
-        _numpy_decode_flat,
+        _native_decode_routes,
+        _numpy_decode_routes,
     )
 
     if rt.load() is None:
@@ -352,17 +352,23 @@ def test_flat_decode_native_matches_numpy():
 
         pytest.skip("native runtime unavailable")
     rng = np.random.default_rng(17)
-    b, nc, nchunks = 64, 4, 16
+    b, padded, nc, nchunks = 61, 64, 4, 16
     w_total = nc * WORDS_PER_CHUNK
-    # ascending unique flat keys (topic-major), sparse coverage
-    all_keys = rng.choice(b * w_total, size=300, replace=False)
-    keys = np.sort(all_keys).astype(np.uint32)
-    bits = rng.integers(1, 1 << 32, size=keys.shape[0], dtype=np.uint32)
-    chunk_ids = rng.integers(0, nchunks, size=(b, nc)).astype(np.int32)
+    # per-topic counts over the real topics; padded tail stays 0
+    cn = np.zeros(padded, dtype=np.int64)
+    cn[:b] = rng.integers(0, 12, size=b)
+    n = int(cn.sum())
+    # routes are flat topic-major; within a topic ascending (widx, bitpos)
+    routes = np.concatenate([
+        np.sort(rng.choice(w_total * 32, size=int(c), replace=False))
+        for c in cn if c
+    ]).astype(np.uint32)
+    assert routes.shape[0] == n
+    chunk_ids = rng.integers(0, nchunks, size=(padded, nc)).astype(np.int32)
     fid_map = rng.integers(0, 1 << 31, size=nchunks * CHUNK).astype(np.int64)
-    got = _native_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    got = _native_decode_routes(routes, cn, chunk_ids, b, fid_map)
     assert got is not None
-    want = _numpy_decode_flat(keys, bits, chunk_ids, b, fid_map)
+    want = _numpy_decode_routes(routes, cn, chunk_ids, b, fid_map)
     assert len(got) == len(want) == b
     for g, w in zip(got, want):
         assert g.tolist() == w.tolist()
