@@ -2,11 +2,27 @@
 
 Replaces the ``lax.scan`` body of `ops/partitioned.py::match_partitioned_impl`
 (gather chunk tile → level match → pack bits) with a hand-pipelined kernel:
-per (topic, candidate-chunk) step, the [CHUNK, L+3] filter tile is DMA'd
-HBM→VMEM double-buffered while the previous tile is matched and bit-packed,
-so the tile never materializes as an XLA intermediate and DMA overlaps
-compute. Grid = one program per ``BT`` topics; candidate chunk ids ride in
-SMEM (they are DMA indices, i.e. scalars).
+per (topic, candidate-chunk) step, the field-major [L+3, CHUNK] filter tile
+is DMA'd HBM→VMEM double-buffered while the previous tile is matched and
+bit-packed, so the tile never materializes as an XLA intermediate and DMA
+overlaps compute. Grid = one program per ``BT`` topics; per-topic scalars
+(tokens, tlen, tdollar, candidate chunk ids) ride in SMEM.
+
+Mosaic-lowering constraints that shaped this kernel (each rejected an
+earlier revision on real TPU — interpret mode hides all of them):
+- no i1-vector reductions or i1-i1 binary ops (widen to i8 + unsupported
+  trunci): every mask is int32; comparisons only feed where(cond, 1, 0);
+- no unsigned reductions: bits pack via int32 sums of distinct powers of
+  two (wrap-exact), bitcast to uint32 at the end;
+- vector stores need static lane offsets: the out block is [BT*nc, WPC]
+  (full-row store at a dynamic sublane offset), same contiguous order as
+  the caller's [B, NC*WPC] view;
+- HBM DMA slices must be 128-aligned in the minor dim: the table tile is
+  field-major [L+3, CHUNK=256] (which also keeps the XLA-side HBM array
+  un-padded — see pack_device_rows);
+- dynamic-sublane vector loads from VMEM blocks are avoided entirely: the
+  per-topic values load as SMEM scalars and broadcast, with the (static)
+  level loop unrolled.
 
 Semantics are identical to the lax path (same [B, NC*WPC] packed words);
 `PartitionedMatcher` verifies that on-device at first use and falls back if
@@ -19,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -29,8 +46,7 @@ BT = 8  # topics per program
 
 
 def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
-            cid_ref, rows_hbm, out_ref):
-    wpc = chunk // 32
+            cid_ref, plo_ref, phi_ref, rows_hbm, out_ref):
     total = BT * nc
 
     def body(scratch, sems):
@@ -53,48 +69,53 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
 
             make_dma(slot, idx).wait()
             t = idx // nc
-            k = idx % nc
-            tile = scratch[slot]  # [CHUNK, L+3] int32
-            ftok = tile[:, :lvl]
-            flen = tile[:, lvl]
-            plen = tile[:, lvl + 1]
-            flags = tile[:, lvl + 2]
-            trow = ttok_ref[pl.ds(t, 1), :]  # [1, L]
-            eq = ftok == trow
-            plus = ftok == PLUS_TOK
-            beyond = (
-                lax.broadcasted_iota(jnp.int32, (chunk, lvl), 1) >= plen[:, None]
-            )
-            # Mosaic cannot lower boolean lane reductions (jnp.all widens
-            # i1->i8 and truncates back, an unsupported trunci) — count the
-            # failing levels in int32 instead
-            bad = jnp.sum(jnp.where(eq | plus | beyond, 0, 1), axis=1)  # [CHUNK]
-            hh = (flags & 1) != 0
-            fw = (flags & 2) != 0
+            tile = scratch[slot]  # [L+3, CHUNK] int32 (field-major)
+            flen = tile[lvl : lvl + 1, :]  # [1, CHUNK]
+            plen = tile[lvl + 1 : lvl + 2, :]
+            flags = tile[lvl + 2 : lvl + 3, :]
+            # count failing levels in int32; a level passes when the filter
+            # token equals the topic token, is '+', or lies beyond the
+            # filter's prefix. The level loop is static (unrolled): topic
+            # tokens are SMEM scalars broadcast across the CHUNK lanes.
+            bad = jnp.zeros((1, chunk), jnp.int32)
+            for level in range(lvl):
+                f = tile[level : level + 1, :]  # [1, CHUNK]
+                e = (
+                    jnp.where(f == ttok_ref[t, level], 1, 0)
+                    + jnp.where(f == PLUS_TOK, 1, 0)
+                    + jnp.where(plen <= level, 1, 0)
+                )
+                bad = bad + jnp.where(e == 0, 1, 0)
+            hh = flags & 1
+            fw = jnp.where((flags & 2) != 0, 1, 0)
             tl = tlen_ref[t, 0]
-            len_ok = jnp.where(hh, tl >= plen, tl == flen)
-            dollar_ok = jnp.logical_not((tdollar_ref[t, 0] != 0) & fw)
-            m32 = jnp.where((bad == 0) & len_ok & dollar_ok, 1, 0)
-            # Mosaic has no unsigned reductions: pack bits via an int32 sum
-            # (distinct powers of two -> wrap-exact two's complement) and
-            # bitcast the packed words to uint32
-            bit = jnp.left_shift(
-                jnp.int32(1),
-                lax.broadcasted_iota(jnp.int32, (wpc, 32), 1),
-            )
-            words = jnp.sum(
-                m32.reshape(wpc, 32) * bit, axis=1,
-                dtype=jnp.int32,
-            )
-            out_ref[pl.ds(t, 1), pl.ds(k * wpc, wpc)] = lax.bitcast_convert_type(
-                words.reshape(1, wpc), jnp.uint32
+            ge = jnp.where(tl >= plen, 1, 0)
+            eqlen = jnp.where(tl == flen, 1, 0)
+            len_ok = hh * ge + (1 - hh) * eqlen
+            dollar_bad = tdollar_ref[t, 0] * fw  # tdollar is 0/1
+            m32 = jnp.where(bad == 0, 1, 0) * len_ok * (1 - dollar_bad)
+            # pack bits on the (otherwise idle) MXU: Mosaic cannot reshape
+            # lanes into sublanes ((1,CHUNK)->(WPC,32)), so word j = Σ
+            # m[j*32+i]<<i is computed as two exact f32 matmuls against
+            # constant selectors (low/high 16 bits per word — each sum of
+            # distinct powers of two stays < 2^16, exact in f32), then
+            # recombined in int32 and bitcast to uint32
+            mf = m32.astype(jnp.float32)  # [1, CHUNK]
+            dims = (((1,), (0,)), ((), ()))
+            wlo = lax.dot_general(mf, plo_ref[...], dims,
+                                  preferred_element_type=jnp.float32)
+            whi = lax.dot_general(mf, phi_ref[...], dims,
+                                  preferred_element_type=jnp.float32)
+            words = wlo.astype(jnp.int32) + (whi.astype(jnp.int32) << 16)
+            out_ref[pl.ds(idx, 1), :] = lax.bitcast_convert_type(
+                words, jnp.uint32  # [1, WPC]
             )
 
         lax.fori_loop(0, total, step, None)
 
     pl.run_scoped(
         body,
-        scratch=pltpu.VMEM((2, chunk, lvl + 3), jnp.int32),
+        scratch=pltpu.VMEM((2, lvl + 3, chunk), jnp.int32),
         sems=pltpu.SemaphoreType.DMA((2,)),
     )
 
@@ -104,29 +125,39 @@ def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
                        interpret: bool = False):
     """→ packed match words [B, NC*WPC] uint32 (B must be a multiple of BT)."""
     b, nc = chunk_ids.shape
-    nchunks, chunk, width = packed_rows.shape
+    nchunks, width, chunk = packed_rows.shape
     lvl = width - 3
     wpc = chunk // 32
     kernel = functools.partial(_kernel, nc, lvl, chunk)
-    return pl.pallas_call(
+    # constant bit-pack selectors: P[c, j] = 2^(c%32 - half*16) when word
+    # c//32 == j and c%32 in the half's 16-bit range, else 0 (see _kernel)
+    c = np.arange(chunk)
+    sel = (c[:, None] // 32) == np.arange(wpc)[None, :]
+    pos = c[:, None] % 32
+    plo = np.where(sel & (pos < 16), 2.0**pos, 0.0).astype(np.float32)
+    phi = np.where(sel & (pos >= 16), 2.0 ** (pos - 16), 0.0).astype(np.float32)
+    out = pl.pallas_call(
         kernel,
         grid=(b // BT,),
         in_specs=[
-            pl.BlockSpec((BT, lvl), lambda i: (i, 0)),
-            # rank-1 blocked arrays need 128-multiple blocks on TPU; carry
-            # the per-topic scalars as [B, 1] columns instead
-            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
-            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BT, lvl), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BT, nc), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
+            pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # packed_rows stays in HBM
         ],
-        out_specs=pl.BlockSpec((BT, nc * wpc), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, nc * wpc), jnp.uint32),
+        out_specs=pl.BlockSpec((BT * nc, wpc), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * nc, wpc), jnp.uint32),
         interpret=interpret,
     )(
         ttok.astype(jnp.int32),
         tlen.astype(jnp.int32).reshape(b, 1),
         tdollar.astype(jnp.int32).reshape(b, 1),
         chunk_ids.astype(jnp.int32),
+        plo,
+        phi,
         packed_rows,
     )
+    return out.reshape(b, nc * wpc)

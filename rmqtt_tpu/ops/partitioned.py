@@ -574,16 +574,18 @@ class PartitionedTable:
 def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
     """lax.scan partitioned match → packed words [B, NC*WPC] uint32.
 
-    ``packed_rows`` is chunk-tiled ``[nchunks, CHUNK, L+3]`` — per-row level
-    tokens followed by (flen, prefix_len, hash|wild flags) so each scan step
-    issues ONE whole-tile gather by leading-axis index (measured ~40× faster
-    on TPU than row-granular gathers, and one big gather beats five small
-    ones — NOTES.md). Word w of topic b covers rows
+    ``packed_rows`` is chunk-tiled FIELD-MAJOR ``[nchunks, L+3, CHUNK]``
+    (see ``pack_device_rows``: the CHUNK-minor layout keeps HBM tiles
+    un-padded) — per-chunk field rows of level tokens followed by (flen,
+    prefix_len, hash|wild flags); each scan step issues ONE whole-tile
+    gather by leading-axis index (measured ~40× faster on TPU than
+    row-granular gathers, and one big gather beats five small ones —
+    NOTES.md). Word w of topic b covers rows
     ``chunk_ids[b, w // WPC]*CHUNK + (w % WPC)*32 .. +31`` — the host maps
     set bits back to global fids.
     """
     b, nc = chunk_ids.shape
-    lvl = packed_rows.shape[-1] - 3
+    lvl = packed_rows.shape[1] - 3
     # inputs may arrive narrow (uint16 tokens/chunk ids, int16 tlen) to
     # halve the host→device transfer; widen on device
     ttok = ttok.astype(jnp.int32)
@@ -593,17 +595,17 @@ def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
     bit = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
 
     def body(_, cid):  # cid: [B]
-        g = packed_rows[cid]  # [B, CHUNK, L+3] single tile gather
-        ftok_g = g[:, :, :lvl]
-        flen_g = g[:, :, lvl]
-        pl_g = g[:, :, lvl + 1]
-        flags = g[:, :, lvl + 2]
+        g = packed_rows[cid]  # [B, L+3, CHUNK] single tile gather
+        ftok_g = g[:, :lvl, :]
+        flen_g = g[:, lvl, :]
+        pl_g = g[:, lvl + 1, :]
+        flags = g[:, lvl + 2, :]
         hh_g = (flags & 1) != 0
         fw_g = (flags & 2) != 0
-        eq = ftok_g == ttok[:, None, :]
+        eq = ftok_g == ttok[:, :, None]
         plus = ftok_g == PLUS_TOK
-        beyond = lvl_idx[None, None, :] >= pl_g[:, :, None]
-        prefix_ok = jnp.all(eq | plus | beyond, axis=-1)  # [B, CHUNK]
+        beyond = lvl_idx[None, :, None] >= pl_g[:, None, :]
+        prefix_ok = jnp.all(eq | plus | beyond, axis=1)  # [B, CHUNK]
         len_ok = jnp.where(hh_g, tlen[:, None] >= pl_g, tlen[:, None] == flen_g)
         dollar_ok = jnp.logical_not(tdollar[:, None] & fw_g)
         m = prefix_ok & len_ok & dollar_ok
@@ -645,7 +647,11 @@ def compact_global_impl(words, budget: int):
     on-device; the caller re-runs with a wider sticky budget (route
     count >= word count, so one check covers both stages).
 
-    → (routes [budget] uint16|uint32, cnts [B] uint16)
+    Routes and counts return CONCATENATED as one array: each host fetch
+    of a device array costs a full tunnel round trip (~72ms measured),
+    so two arrays per match would double the per-batch fetch latency.
+
+    → packed [budget + B] uint16|uint32: [routes..., cnts...]
     """
     b, w = words.shape
     flat = words.ravel()
@@ -665,18 +671,19 @@ def compact_global_impl(words, budget: int):
     rnzi = bitm.astype(jnp.int32).ravel()  # [budget*32]
     rpos = jnp.cumsum(rnzi) - rnzi
     ridx = jnp.where((rnzi > 0) & (rpos < budget), rpos, budget)
-    rdt = jnp.uint16 if w * 32 <= 0x10000 else jnp.uint32
+    # one dtype for routes AND counts (they ship as one array); strict <
+    # because a count can reach w*32 itself (a topic matching every row)
+    rdt = jnp.uint16 if w * 32 < 0x10000 else jnp.uint32
     rval = (
         widx[:, None] * 32 + jnp.arange(32, dtype=jnp.int32)
     ).ravel().astype(rdt)
     routes = jnp.zeros((budget,), rdt).at[ridx].set(rval, mode="drop")
     cnts = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=1)
-    cdt = jnp.uint16 if w * 32 < 0x10000 else jnp.int32  # count <= w*32
-    return routes, cnts.astype(cdt)
+    return jnp.concatenate([routes, cnts.astype(rdt)])
 
 
 def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int):
-    """Gather-based partitioned match → global-compact (routes, cnts)."""
+    """Gather-based partitioned match → global-compact packed [budget+B]."""
     words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
     return compact_global_impl(words, budget)
 
@@ -719,12 +726,20 @@ _compact_words = jax.jit(compact_words_impl, static_argnames=("max_words",))
 
 
 def pack_device_rows(t: PartitionedTable) -> np.ndarray:
-    """The device mirror of a table: chunk-tiled ``[nchunks, CHUNK, L+3]``
-    rows (tokens + flen + prefix_len + hash|wild flags), active prefix
-    padded to a pow2 chunk count (floor 64) so table growth does not change
-    the array shape on every new chunk — each pow2 bucket costs ONE kernel
-    recompile. Padding rows are zeros (flen=0), rejected for every topic.
-    Single source of the row layout for the local and mesh-sharded paths.
+    """The device mirror of a table: chunk-tiled ``[nchunks, L+3, CHUNK]``
+    FIELD-MAJOR rows (tokens + flen + prefix_len + hash|wild flags), active
+    prefix padded to a pow2 chunk count (floor 64) so table growth does not
+    change the array shape on every new chunk — each pow2 bucket costs ONE
+    kernel recompile. Padding rows are zeros (flen=0), rejected for every
+    topic. Single source of the row layout for the local and mesh-sharded
+    paths.
+
+    Field-major matters: XLA tiles the two minor dims to (8, 128), so a
+    row-major ``[.., CHUNK, L+3]`` tile pads L+3=11 lanes to 128 — 11.6x
+    the HBM footprint and gather traffic (measured as a 1.07 GB resident
+    table at 1M subs). ``[.., L+3, CHUNK]`` keeps the minor dim at 256
+    full lanes (and 128-aligned for the Pallas kernel's HBM→VMEM DMA
+    slices); only the 11→16 sublane pad remains.
     """
     up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
     rows = t.nchunks * CHUNK
@@ -736,7 +751,9 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     packed[:rows, lvl + 2] = t.has_hash[:rows].astype(np.int32) | (
         t.first_wild[:rows] << 1
     )
-    return packed.reshape(-1, CHUNK, lvl + 3)
+    return np.ascontiguousarray(
+        packed.reshape(-1, CHUNK, lvl + 3).transpose(0, 2, 1)
+    )
 
 
 class PartitionedMatcher:
@@ -773,6 +790,7 @@ class PartitionedMatcher:
     def _decide_pallas(self, dev, ttok, tlen, tdollar, chunk_ids) -> bool:
         import logging
         import os
+        import time
 
         env = os.environ.get("RMQTT_PALLAS", "")
         if env == "0":
@@ -789,12 +807,35 @@ class PartitionedMatcher:
                 match_words_pallas(dev, ttok, tlen, tdollar, chunk_ids,
                                    interpret=self._pallas_interpret)
             )
-            want = np.asarray(
-                jax.jit(scan_words_impl)(dev, ttok, tlen, tdollar, chunk_ids)
-            )
+            lax_fn = jax.jit(scan_words_impl)
+            want = np.asarray(lax_fn(dev, ttok, tlen, tdollar, chunk_ids))
             if not np.array_equal(got, want):
                 log.warning("pallas match kernel disagrees with lax path; disabled")
                 return False
+            if env != "1":
+                # correctness is necessary, not sufficient: race both paths
+                # (timed via a small dependent fetch — block_until_ready is
+                # unreliable on tunneled backends) and keep the faster one
+                def clock(fn, reps=3):
+                    red = jax.jit(lambda *a: fn(*a).sum())
+                    int(red(dev, ttok, tlen, tdollar, chunk_ids))  # warm
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        int(red(dev, ttok, tlen, tdollar, chunk_ids))
+                    return (time.perf_counter() - t0) / reps
+
+                t_pallas = clock(match_words_pallas)
+                t_lax = clock(scan_words_impl)
+                if t_pallas >= t_lax:
+                    log.info(
+                        "pallas match kernel verified but slower than lax "
+                        "(%.1fms vs %.1fms); using lax", t_pallas * 1e3,
+                        t_lax * 1e3)
+                    return False
+                log.info(
+                    "pallas match kernel verified and faster than lax "
+                    "(%.1fms vs %.1fms); enabled", t_pallas * 1e3, t_lax * 1e3)
+                return True
             log.info("pallas match kernel verified on %s; enabled", platform)
             return True
         except Exception as e:  # compile/runtime failure: stay on lax
@@ -802,11 +843,21 @@ class PartitionedMatcher:
             return False
 
     def _words(self, dev, ttok, tlen, tdollar, chunk_ids):
+        import os
+
         from rmqtt_tpu.ops.pallas_match import BT
 
         if chunk_ids.shape[0] % BT:
             return None  # pallas grid needs a BT-multiple batch
         if self._pallas is None:
+            if (chunk_ids.shape[0] < 1024
+                    and os.environ.get("RMQTT_PALLAS", "") != "1"):
+                # the verify+race decision latches for the process lifetime:
+                # deciding on an unrepresentative tiny batch (a broker's
+                # first match is often ONE topic, padded to BT) would let
+                # per-call overhead disqualify the kernel for the large-batch
+                # regime it was built for — stay on lax until a real batch
+                return None
             self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar, chunk_ids)
         if self._pallas:
             from rmqtt_tpu.ops.pallas_match import match_words_pallas
@@ -862,22 +913,22 @@ class PartitionedMatcher:
                 g = max(256, 1 << (4 * padded - 1).bit_length())
                 self._budgets[padded] = g
             if words is not None:
-                routes, cnts = _compact_global(words, budget=g)
+                packed = _compact_global(words, budget=g)
                 grouped = None
             else:
                 grouped = self._group_inputs(enc[5], chunk_ids)
                 if grouped is None:  # batch doesn't dedup; plain upload
-                    routes, cnts = _match_global(
+                    packed = _match_global(
                         dev, ttok, tlen, tdollar, chunk_ids, budget=g
                     )
                 else:
-                    routes, cnts = _match_global_grouped(
+                    packed = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
             # the handle carries ITS OWN budget: a sticky widening by a later
             # handle must not mask this one's truncation
             return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
-                    routes, cnts, g)
+                    packed, g)
         wi, wb, cn = (
             _compact_words(words, max_words=self.max_words)
             if words is not None
@@ -932,10 +983,14 @@ class PartitionedMatcher:
         return uniq_cand, inv.astype(inv_dt, copy=False)
 
     def _complete_global(self, handle) -> List[np.ndarray]:
-        _tag, b, chunk_ids, words, dev_inputs, routes, cnts, g = handle
+        _tag, b, chunk_ids, words, dev_inputs, packed, g = handle
         padded = chunk_ids.shape[0]
         while True:
-            cn = np.asarray(cnts, dtype=np.int64)  # counts are truncation-exact
+            # ONE fetch per match: [routes..., cnts...] (counts are
+            # truncation-exact, so overflow is detectable from the same
+            # array that carries the routes)
+            arr = np.asarray(packed)
+            cn = arr[g:].astype(np.int64)
             n = int(cn.sum())
             if n <= g:
                 break
@@ -943,19 +998,19 @@ class PartitionedMatcher:
             # sticky pow2 regrow for this batch size
             self._budgets[padded] = max(self._budgets.get(padded, 0), g)
             if words is not None:
-                routes, cnts = _compact_global(words, budget=g)
+                packed = _compact_global(words, budget=g)
             else:
                 dev, ttok, tlen, tdollar, grouped = dev_inputs
                 if grouped is None:
-                    routes, cnts = _match_global(
+                    packed = _match_global(
                         dev, ttok, tlen, tdollar, chunk_ids, budget=g
                     )
                 else:
-                    routes, cnts = _match_global_grouped(
+                    packed = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
         return _decode_routes(
-            np.asarray(routes)[:n], cn, chunk_ids, b, self.table._fid_of_row
+            arr[:n], cn, chunk_ids, b, self.table._fid_of_row
         )
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:

@@ -152,15 +152,15 @@ class ShardedPartitionedMatcher:
             jax.shard_map,
             mesh=self.mesh,
             in_specs=(P(), P(axes, None), P(axes), P(axes), P(axes, None)),
-            out_specs=(P(axes), P(axes)),
+            out_specs=P(axes),
         )
         def gstep(rows, ttok, tlen, td, cids):
             words = scan_words_impl(rows, ttok, tlen, td, cids)
-            # routes are topic-LOCAL (widx*32+bitpos) and cnts is the shard's
-            # per-topic count vector — shard-major == topic-major, so the
-            # host reattributes slots from the concatenated counts
-            routes, cnts = compact_global_impl(words, budget_per_dev)
-            return routes, cnts
+            # per-device packed [budget, routes... | cnts...]: routes are
+            # topic-LOCAL (widx*32+bitpos) and cnts is the shard's per-topic
+            # count vector — shard-major == topic-major, so the host
+            # reattributes slots from the concatenated counts
+            return compact_global_impl(words, budget_per_dev)
 
         step = jax.jit(gstep)
         self._gsteps[budget_per_dev] = step
@@ -215,20 +215,23 @@ class ShardedPartitionedMatcher:
         if gd is None:
             gd = max(256, 1 << (4 * (padded // self.ndev) - 1).bit_length())
             self._budgets[padded] = gd
+        bl = padded // self.ndev  # topics per device
         while True:
-            routes, cnts = self._global_step(gd)(dev, *inputs)
-            cn = np.asarray(cnts, dtype=np.int64)  # [padded], shard-major
-            totals = cn.reshape(self.ndev, -1).sum(axis=1)
+            # one fetch: per-device [routes(gd)... | cnts(bl)...], concatenated
+            arr = np.asarray(self._global_step(gd)(dev, *inputs))
+            per_dev = arr.reshape(self.ndev, gd + bl)
+            cn = per_dev[:, gd:].astype(np.int64)  # [ndev, bl], shard-major
+            totals = cn.sum(axis=1)
             mx = int(totals.max(initial=0))
             if mx <= gd:
                 break
             # a shard overflowed its slice: regrow (sticky) and re-run
             gd = 1 << max(8, (mx - 1).bit_length())
             self._budgets[padded] = max(self._budgets[padded], gd)
-        routes = np.asarray(routes)
         # concatenate each shard's valid prefix; shard-major == topic-major,
         # so the concatenated counts reattribute slots globally
-        parts = [routes[i * gd : i * gd + int(totals[i])] for i in range(self.ndev)]
+        parts = [per_dev[i, : int(totals[i])] for i in range(self.ndev)]
         return _decode_routes(
-            np.concatenate(parts), cn, chunk_ids, b, self.table._fid_of_row,
+            np.concatenate(parts), cn.ravel(), chunk_ids, b,
+            self.table._fid_of_row,
         )

@@ -134,21 +134,21 @@ def main():
 
     def run_global():
         h = mg.match_submit(batch, pad_to_pow2=False)
-        (_tag, _b, _cids, _words, _devin, routes, cnts, budget) = h
-        cnts = np.asarray(cnts)
-        n = int(cnts.astype(np.int64).sum())
+        (_tag, _b, _cids, _words, _devin, packed, budget) = h
+        arr = np.asarray(packed)  # ONE fetch: [routes... | cnts...]
+        n = int(arr[budget:].astype(np.int64).sum())
         assert n <= budget, f"budget overflow mid-profile ({n} > {budget})"
-        return np.asarray(routes), cnts
+        return arr, budget
 
-    gfull_t, (groutes, gcnts) = timed(run_global, n=args.rounds)
+    gfull_t, (garr, gbud) = timed(run_global, n=args.rounds)
     from rmqtt_tpu.ops.partitioned import _decode_routes
 
-    gcn = gcnts.astype(np.int64)
+    gcn = garr[gbud:].astype(np.int64)
     total = int(gcn.sum())
-    gdec_t, grows = timed(lambda: _decode_routes(groutes[:total], gcn,
+    gdec_t, grows = timed(lambda: _decode_routes(garr[:total], gcn,
                                                  chunk_ids, b,
                                                  table._fid_of_row), n=args.rounds)
-    gbytes = groutes.nbytes + gcnts.nbytes
+    gbytes = garr.nbytes
     print(f"global: budget={g} total={total} fetch {gfull_t * 1e3:.1f} ms "
           f"({gbytes / 1e6:.2f} MB) decode {gdec_t * 1e3:.1f} ms "
           f"(routes: {sum(len(r) for r in grows)})")
