@@ -446,16 +446,32 @@ def main():
         global _PROFILE_DIR
         _PROFILE_DIR = args.profile
 
+    interrupted = False
+
     def guarded(name, fn):
-        """A late config failing (OOM at 10M subs, driver timeout nearing)
-        must not lose the results already measured."""
+        """A late config failing (OOM at 10M subs, driver timeout nearing,
+        the accelerator wedging mid-run) must not lose the results already
+        measured — even SIGINT falls through to the JSON print below."""
+        nonlocal interrupted
+        if interrupted:
+            failures[name] = "skipped: interrupted"
+            return
         try:
             results[name] = fn()
         except KeyboardInterrupt:
-            raise
+            interrupted = True
+            failures[name] = "KeyboardInterrupt (timeout/wedge?)"
+            log(f"{name} INTERRUPTED — emitting the configs already measured")
         except BaseException as e:
             failures[name] = f"{type(e).__name__}: {e}"
             log(f"{name} FAILED: {failures[name]}")
+            if on_tpu and not tpu_available(probe_timeout=30.0, retries=1):
+                # the accelerator wedged mid-run: later configs would spend
+                # minutes building tables only to hang on their first device
+                # call — emit what was measured instead
+                interrupted = True
+                log("accelerator unreachable after failure — skipping "
+                    "remaining configs")
 
     if want(1):
         def cfg1():

@@ -69,7 +69,9 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
 
             make_dma(slot, idx).wait()
             t = idx // nc
-            tile = scratch[slot]  # [L+3, CHUNK] int32 (field-major)
+            # [L+3, CHUNK] field-major; tiles may ship int16 (half the DMA
+            # bytes) — widen once after load, the mask math stays int32
+            tile = scratch[slot].astype(jnp.int32)
             flen = tile[lvl : lvl + 1, :]  # [1, CHUNK]
             plen = tile[lvl + 1 : lvl + 2, :]
             flags = tile[lvl + 2 : lvl + 3, :]
@@ -115,7 +117,7 @@ def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
 
     pl.run_scoped(
         body,
-        scratch=pltpu.VMEM((2, lvl + 3, chunk), jnp.int32),
+        scratch=pltpu.VMEM((2, lvl + 3, chunk), rows_hbm.dtype),
         sems=pltpu.SemaphoreType.DMA((2,)),
     )
 

@@ -147,16 +147,18 @@ class PartitionedTable:
         # native (C++) encoder: None = not tried yet, False = unavailable
         self._nenc = None
         self._nc_cap = 32
-        # upload dtypes: uint16 while ids fit (halves the per-batch host→
-        # device transfer of ttok/chunk_ids on the measured tunnel); STICKY
-        # once widened so the jit signature flips at most once each
+        # narrow dtypes while ids fit: halves the per-batch host→device
+        # upload of ttok/chunk_ids on the measured tunnel AND the device
+        # tiles' gather traffic (pack_device_rows shares _tok_wide, so the
+        # bound is int16's, not uint16's); STICKY once widened so the jit
+        # signature flips at most once each
         self._tok_wide = False
         self._cand_wide = False
 
     def _tok_dtype(self):
-        if not self._tok_wide and _FIRST_TOK + len(self.tokens) >= 0xFFFF:
+        if not self._tok_wide and _FIRST_TOK + len(self.tokens) >= 0x7FFF:
             self._tok_wide = True
-        return np.int32 if self._tok_wide else np.uint16
+        return np.int32 if self._tok_wide else np.int16
 
     def _cand_dtype(self):
         if not self._cand_wide and self.nchunks >= 0x10000:
@@ -586,7 +588,7 @@ def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
     """
     b, nc = chunk_ids.shape
     lvl = packed_rows.shape[1] - 3
-    # inputs may arrive narrow (uint16 tokens/chunk ids, int16 tlen) to
+    # inputs may arrive narrow (int16 tokens, uint16 chunk ids, int16 tlen) to
     # halve the host→device transfer; widen on device
     ttok = ttok.astype(jnp.int32)
     tlen = tlen.astype(jnp.int32)
@@ -740,16 +742,23 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     table at 1M subs). ``[.., L+3, CHUNK]`` keeps the minor dim at 256
     full lanes (and 128-aligned for the Pallas kernel's HBM→VMEM DMA
     slices); only the 11→16 sublane pad remains.
+
+    Dtype matters the same way: while the token vocabulary fits (tracked
+    by the table's upload narrowing), tiles ship as int16 — the per-batch
+    gather traffic (the scan's HBM wall: B×NC tile reads per match)
+    halves again, and int16 compares run at twice the VPU lane density.
+    flen/prefix_len (≤ L+1) and the 2-bit flags always fit.
     """
     up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
     rows = t.nchunks * CHUNK
     lvl = t.max_levels
-    packed = np.zeros((up_chunks * CHUNK, lvl + 3), dtype=np.int32)
-    packed[:rows, :lvl] = t.tok[:rows]
+    dt = np.int32 if t._tok_wide else np.int16
+    packed = np.zeros((up_chunks * CHUNK, lvl + 3), dtype=dt)
+    packed[:rows, :lvl] = t.tok[:rows].astype(dt)
     packed[:rows, lvl] = t.flen[:rows]
     packed[:rows, lvl + 1] = t.prefix_len[:rows]
-    packed[:rows, lvl + 2] = t.has_hash[:rows].astype(np.int32) | (
-        t.first_wild[:rows] << 1
+    packed[:rows, lvl + 2] = t.has_hash[:rows].astype(dt) | (
+        t.first_wild[:rows].astype(dt) << 1
     )
     return np.ascontiguousarray(
         packed.reshape(-1, CHUNK, lvl + 3).transpose(0, 2, 1)
@@ -798,6 +807,12 @@ class PartitionedMatcher:
         platform = next(iter(dev.devices())).platform if hasattr(dev, "devices") else ""
         if platform != "tpu" and env != "1":
             return False
+        global _PALLAS_RACED
+        if env != "1" and _PALLAS_RACED is not None:
+            # one verify+race per process: each race costs a pallas compile
+            # (~40s over the tunnel AOT helper) and a fresh matcher per
+            # table (the bench builds one per config) must not re-pay it
+            return _PALLAS_RACED
         log = logging.getLogger("rmqtt_tpu.ops")
         try:
             from rmqtt_tpu.ops.pallas_match import match_words_pallas
@@ -811,6 +826,8 @@ class PartitionedMatcher:
             want = np.asarray(lax_fn(dev, ttok, tlen, tdollar, chunk_ids))
             if not np.array_equal(got, want):
                 log.warning("pallas match kernel disagrees with lax path; disabled")
+                if env != "1":
+                    _PALLAS_RACED = False
                 return False
             if env != "1":
                 # correctness is necessary, not sufficient: race both paths
@@ -826,20 +843,18 @@ class PartitionedMatcher:
 
                 t_pallas = clock(match_words_pallas)
                 t_lax = clock(scan_words_impl)
-                if t_pallas >= t_lax:
-                    log.info(
-                        "pallas match kernel verified but slower than lax "
-                        "(%.1fms vs %.1fms); using lax", t_pallas * 1e3,
-                        t_lax * 1e3)
-                    return False
+                _PALLAS_RACED = bool(t_pallas < t_lax)
                 log.info(
-                    "pallas match kernel verified and faster than lax "
-                    "(%.1fms vs %.1fms); enabled", t_pallas * 1e3, t_lax * 1e3)
-                return True
+                    "pallas match kernel verified; %s (%.1fms vs lax %.1fms)",
+                    "enabled" if _PALLAS_RACED else "slower, using lax",
+                    t_pallas * 1e3, t_lax * 1e3)
+                return _PALLAS_RACED
             log.info("pallas match kernel verified on %s; enabled", platform)
             return True
         except Exception as e:  # compile/runtime failure: stay on lax
             log.warning("pallas match kernel unavailable (%s); using lax path", e)
+            if env != "1":
+                _PALLAS_RACED = False
             return False
 
     def _words(self, dev, ttok, tlen, tdollar, chunk_ids):

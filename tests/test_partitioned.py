@@ -375,12 +375,12 @@ def test_routes_decode_native_matches_numpy():
 
 
 def test_upload_dtype_narrowing():
-    """ttok/chunk_ids upload as uint16 (tlen int16) while ids fit, widen
+    """ttok uploads as int16 / chunk_ids as uint16 (tlen int16) while ids fit, widen
     stickily to int32, and both widths route identically."""
     table = PartitionedTable()
     fid = table.add("a/b/c")
     ttok, tlen, _td, cand, _nc = table.encode_topics(["a/b/c", "x/y"])
-    assert ttok.dtype == np.uint16 and cand.dtype == np.uint16
+    assert ttok.dtype == np.int16 and cand.dtype == np.uint16
     assert tlen.dtype == np.int16
     m = PartitionedMatcher(table)
     r1, r2 = m.match(["a/b/c", "x/y"])
