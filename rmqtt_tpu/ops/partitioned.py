@@ -704,6 +704,15 @@ _match_global = jax.jit(match_global_impl, static_argnames=("budget",))
 _match_global_grouped = jax.jit(match_global_grouped_impl, static_argnames=("budget",))
 _compact_global = jax.jit(compact_global_impl, static_argnames=("budget",))
 
+# process-wide pallas verify+race outcome (None = not yet decided); each race
+# costs a full pallas compile, so every matcher in the process shares it
+_PALLAS_RACED: Optional[bool] = None
+
+
+def _platform(dev) -> str:
+    """Platform of a device array (single source for the decide paths)."""
+    return next(iter(dev.devices())).platform if hasattr(dev, "devices") else ""
+
 
 def compact_words_impl(words, max_words: int):
     """Packed words → (word_idx, word_bits, counts) compaction (shared by
@@ -804,7 +813,7 @@ class PartitionedMatcher:
         env = os.environ.get("RMQTT_PALLAS", "")
         if env == "0":
             return False
-        platform = next(iter(dev.devices())).platform if hasattr(dev, "devices") else ""
+        platform = _platform(dev)
         if platform != "tpu" and env != "1":
             return False
         global _PALLAS_RACED
@@ -865,15 +874,30 @@ class PartitionedMatcher:
         if chunk_ids.shape[0] % BT:
             return None  # pallas grid needs a BT-multiple batch
         if self._pallas is None:
-            if (chunk_ids.shape[0] < 1024
-                    and os.environ.get("RMQTT_PALLAS", "") != "1"):
+            env = os.environ.get("RMQTT_PALLAS", "")
+            if (env not in ("0", "1") and _PALLAS_RACED is None
+                    and chunk_ids.shape[0] < 1024 and _platform(dev) == "tpu"):
                 # the verify+race decision latches for the process lifetime:
                 # deciding on an unrepresentative tiny batch (a broker's
                 # first match is often ONE topic, padded to BT) would let
                 # per-call overhead disqualify the kernel for the large-batch
-                # regime it was built for — stay on lax until a real batch
+                # regime it was built for — stay on lax until a real batch.
+                # Every OTHER undecided case (non-TPU, forced env, settled
+                # race) resolves compile-free inside _decide_pallas, so
+                # small-batch-only processes still latch and stop BT padding
                 return None
-            self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar, chunk_ids)
+            try:
+                self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar,
+                                                   chunk_ids)
+            except Exception as e:
+                # any decide-path surprise (e.g. a wedged backend raising
+                # from dev.devices()) degrades to lax, never crashes the
+                # match path
+                import logging
+
+                logging.getLogger("rmqtt_tpu.ops").warning(
+                    "pallas decide path failed (%s); using lax path", e)
+                self._pallas = False
         if self._pallas:
             from rmqtt_tpu.ops.pallas_match import match_words_pallas
 

@@ -424,3 +424,26 @@ def test_grouped_upload_dedup_parity():
     uniq_groups = np.arange(64, dtype=np.int32)
     fake_cand = np.zeros((64, 4), dtype=np.uint16)
     assert m._group_inputs(uniq_groups, fake_cand) is None
+
+
+def test_pallas_decision_latches_off_small_batches_on_cpu(monkeypatch):
+    """ADVICE r2: (a) the process-wide race flag exists at module scope so
+    the decide path cannot NameError on a real TPU; (b) a CPU-platform
+    process latches _pallas=False on its FIRST small batch, so small-batch
+    workloads stop paying BT padding without ever seeing a >=1024 batch."""
+    import rmqtt_tpu.ops.partitioned as P
+
+    assert hasattr(P, "_PALLAS_RACED")  # module-scope init (was a NameError)
+    monkeypatch.delenv("RMQTT_PALLAS", raising=False)
+    table = PartitionedTable()
+    fids = {}
+    for f in ("a/b", "a/+", "x/#"):
+        fids[table.add(f)] = f
+    m = PartitionedMatcher(table)
+    rows = m.match(["a/b"])
+    assert sorted(fids[i] for i in rows[0].tolist()) == ["a/+", "a/b"]
+    assert m._pallas is False  # latched without a >=1024 batch
+    # with pallas ruled out, a 1-topic submit no longer pads to the BT grid
+    h = m.match_submit(["a/b"])
+    chunk_ids = h[2]
+    assert chunk_ids.shape[0] == 1
