@@ -415,9 +415,12 @@ def main():
     )
     args = ap.parse_args()
 
+    import os
+
     import jax
 
     on_tpu = not args.cpu and tpu_available()
+    reduced = False
     if not on_tpu:
         if not args.cpu:
             log("TPU unreachable — falling back to CPU platform (reduced sizes)")
@@ -425,7 +428,14 @@ def main():
 
         _eb.clear_backends()  # a preload may override JAX_PLATFORMS (tpuprobe)
         jax.config.update("jax_platforms", "cpu")
-        args.smoke = args.smoke or args.config is None  # keep CPU runs small
+        # still run cfgs 1-3 at reduced-but-nontrivial sizes: a wedged-chip
+        # driver run must emit a multi-config, information-bearing artifact
+        # (round 2 recorded only cfg1@200subs and lost the round's progress)
+        reduced = args.config is None and not args.smoke
+    else:
+        # a wedge mid-run must fail the one config, not hang the process:
+        # every device fetch in the match/scan paths honors this deadline
+        os.environ.setdefault("RMQTT_FETCH_TIMEOUT", "180")
 
     rng = random.Random(args.seed)
     platform = jax.devices()[0].platform
@@ -438,6 +448,8 @@ def main():
             return i == 1
         if args.config is not None:
             return i == args.config
+        if reduced:
+            return i <= 3  # CPU fallback: reduced cfg1-3
         # on real TPU the default is ALL FIVE baseline configs
         return i <= 3 or args.full or on_tpu
 
@@ -485,18 +497,20 @@ def main():
 
     if want(2):
         def cfg2():
-            filters = gen_single_plus(rng, 100_000)
+            n, nt, bs = (20_000, 8_192, 2048) if reduced else (100_000, 20_000, 8192)
+            filters = gen_single_plus(rng, n)
             # depth 3-5 filters over l{d}n{...} names: generate matching-shape topics
-            topics = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5))) for _ in range(20_000)]
-            return run_config("cfg2_plus_100k", filters, topics, 8192, 512)
+            topics = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5))) for _ in range(nt)]
+            return run_config("cfg2_plus_100k", filters, topics, bs, 512)
 
         guarded("cfg2_plus_100k", cfg2)
 
     if want(3):
         def cfg3():
-            filters = gen_mixed(rng, 1_000_000)
-            topics = gen_topics_uniform(rng, 32_768)
-            return run_config("cfg3_mixed_1m", filters, topics, 16384, 256)
+            n, nt, bs = (100_000, 8_192, 2048) if reduced else (1_000_000, 32_768, 16384)
+            filters = gen_mixed(rng, n)
+            topics = gen_topics_uniform(rng, nt)
+            return run_config("cfg3_mixed_1m", filters, topics, bs, 256)
 
         guarded("cfg3_mixed_1m", cfg3)
 
@@ -536,34 +550,67 @@ def main():
         if headline in results:
             break
     r = results[headline]
-    print(
-        json.dumps(
-            {
-                "metric": f"publish_route_topics_per_sec[{headline}]",
-                "value": round(r["tpu"]["topics_per_sec"], 1),
-                "unit": "topics/s",
-                "vs_baseline": round(r["speedup"], 2),
-                "routes_per_sec": round(r["tpu"]["routes_per_sec"], 1),
-                "p99_ms": round(r["tpu"]["p99_ms"], 2),
-                "platform": platform,
-                "baseline": r["baseline_kind"],
-                "configs": {
-                    k: {
-                        "tpu_topics_per_sec": round(v["tpu"]["topics_per_sec"], 1),
-                        "tpu_backend": v["tpu_backend"],
-                        "cpu_topics_per_sec": round(v["cpu"]["topics_per_sec"], 1),
-                        "cpu_native_topics_per_sec": (
-                            round(v["cpu_native"]["topics_per_sec"], 1) if v["cpu_native"] else None
-                        ),
-                        "speedup": round(v["speedup"], 2),
-                        "p99_ms": round(v["tpu"]["p99_ms"], 2),
-                    }
-                    for k, v in results.items()
-                },
-                **({"failed_configs": failures} if failures else {}),
+    # reduced-size fallback numbers must not masquerade as full-config
+    # results: the metric name and every config entry carry the marker
+    tag = "@reduced" if reduced else ""
+    out = {
+        "metric": f"publish_route_topics_per_sec[{headline}{tag}]",
+        "value": round(r["tpu"]["topics_per_sec"], 1),
+        "unit": "topics/s",
+        "vs_baseline": round(r["speedup"], 2),
+        "routes_per_sec": round(r["tpu"]["routes_per_sec"], 1),
+        "p99_ms": round(r["tpu"]["p99_ms"], 2),
+        "platform": platform,
+        "baseline": r["baseline_kind"],
+        "configs": {
+            k: {
+                "tpu_topics_per_sec": round(v["tpu"]["topics_per_sec"], 1),
+                "tpu_backend": v["tpu_backend"],
+                "cpu_topics_per_sec": round(v["cpu"]["topics_per_sec"], 1),
+                "cpu_native_topics_per_sec": (
+                    round(v["cpu_native"]["topics_per_sec"], 1) if v["cpu_native"] else None
+                ),
+                "speedup": round(v["speedup"], 2),
+                "p99_ms": round(v["tpu"]["p99_ms"], 2),
+                **({"retained": v["retained"]} if "retained" in v else {}),
+                **({"reduced_sizes": True} if reduced else {}),
             }
-        )
-    )
+            for k, v in results.items()
+        },
+        **({"failed_configs": failures} if failures else {}),
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    _persist_last_tpu(out, on_tpu)
+    print(json.dumps(out))
+
+
+_LAST_TPU_PATH = __file__.replace("bench.py", "BENCH_LAST_TPU.json")
+
+
+def _persist_last_tpu(out: dict, on_tpu: bool) -> None:
+    """Real-chip results persist across runs: a later wedged-chip driver run
+    still carries the last on-chip numbers (clearly labeled as prior-run)
+    instead of emitting a near-zero-information CPU artifact (round 2 lost
+    its real progress to exactly this)."""
+    try:
+        if on_tpu:
+            snap = {k: out[k] for k in
+                    ("metric", "value", "unit", "vs_baseline", "configs") if k in out}
+            snap["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            if "failed_configs" in out:
+                snap["failed_configs"] = out["failed_configs"]
+            with open(_LAST_TPU_PATH, "w") as f:
+                json.dump(snap, f, indent=1)
+        else:
+            with open(_LAST_TPU_PATH) as f:
+                out["last_tpu_run"] = json.load(f)
+            out["last_tpu_run"]["note"] = (
+                "prior-run on-chip results (this run fell back to CPU)"
+            )
+    except FileNotFoundError:
+        pass
+    except Exception as e:  # the artifact must print regardless
+        log(f"last-tpu persistence skipped: {e}")
 
 
 if __name__ == "__main__":

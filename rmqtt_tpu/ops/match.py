@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 
 from rmqtt_tpu.ops.encode import PLUS_TOK, FilterTable
+from rmqtt_tpu.utils.devfetch import fetch
 
 # Filters processed per scan step; bounds per-chunk HBM traffic.
 DEFAULT_CHUNK = 1 << 16
@@ -327,21 +328,21 @@ class TpuMatcher:
         padded = 1 << (b - 1).bit_length() if (pad_to_pow2 and b > 1) else b
         ttok, tlen, tdollar = self.table.encode_topics(topics, pad_batch_to=padded)
         if padded * (self.table.capacity // 8) <= COMPACT_BITMAP_BYTES:
-            packed = np.asarray(self.match_encoded(ttok, tlen, tdollar))
+            packed = fetch(self.match_encoded(ttok, tlen, tdollar), "dense bitmap fetch")
             return unpack_bitmap(packed[:b], nrows=self.table.capacity)
         dev = self._refresh()
         word_idx, word_bits, counts = _match_words(
             *dev, ttok, tlen, tdollar, nchunks=self._nchunks(), max_words=self.max_matches
         )
         rows, overflow = decode_words(
-            np.asarray(word_idx), np.asarray(word_bits), np.asarray(counts), self.max_matches
+            fetch(word_idx), fetch(word_bits), fetch(counts), self.max_matches
         )
         rows = rows[:b]
         overflow = [j for j in overflow if j < b]
         if overflow:
             # rare fan-out overflow: re-resolve those topics via the bitmap path
             otok, olen, odollar = self.table.encode_topics([topics[j] for j in overflow])
-            packed = np.asarray(self.match_encoded(otok, olen, odollar))
+            packed = fetch(self.match_encoded(otok, olen, odollar), "overflow bitmap fetch")
             full = unpack_bitmap(packed, nrows=self.table.capacity)
             for i, j in enumerate(overflow):
                 rows[j] = full[i]

@@ -36,6 +36,7 @@ from jax import lax
 
 from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
 from rmqtt_tpu.ops.encode import _FIRST_TOK, HASH_TOK, PAD_TOK, PLUS_TOK, TokenDict, UNK_TOK
+from rmqtt_tpu.utils.devfetch import fetch
 
 CHUNK = 128  # rows per partition chunk (4 packed words)
 WORDS_PER_CHUNK = CHUNK // 32
@@ -700,6 +701,33 @@ def match_global_grouped_impl(packed_rows, ttok, tlen, tdollar, uniq_cand, inv,
     return match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget)
 
 
+def match_global_split_impl(packed_rows, parts, budgets):
+    """NC split-dispatch: the scan costs B×NC tile gathers, but measured
+    batches average ~7 candidate chunks against an NC=32 pad — most of the
+    device compute was padding (NOTES.md). Topics are bucketed host-side by
+    candidate count into a short NC-tier ladder; each bucket scans only its
+    tier's chunks. One jit call runs every bucket and concatenates the
+    per-bucket compacted outputs, so the batch still costs ONE dispatch and
+    ONE fetch (each extra fetch is a full tunnel RTT).
+
+    ``parts``: per bucket ``(ttok, tlen, tdollar, chunk_ids)``;
+    ``budgets``: per-bucket static slot budgets.
+    → concatenation of each bucket's ``[budget_b + padded_b]`` packed array
+    (a bucket's segment is ``[routes(budget_b)..., cnts(padded_b)...]``).
+    """
+    outs = [
+        match_global_impl(packed_rows, *p, budget=g)
+        for p, g in zip(parts, budgets)
+    ]
+    dt = (jnp.uint32 if any(o.dtype == jnp.uint32 for o in outs)
+          else jnp.uint16)
+    return jnp.concatenate([o.astype(dt) for o in outs])
+
+
+_match_global_split = jax.jit(match_global_split_impl,
+                              static_argnames=("budgets",))
+
+
 _match_global = jax.jit(match_global_impl, static_argnames=("budget",))
 _match_global_grouped = jax.jit(match_global_grouped_impl, static_argnames=("budget",))
 _compact_global = jax.jit(compact_global_impl, static_argnames=("budget",))
@@ -795,11 +823,14 @@ class PartitionedMatcher:
         # ~4x less device→host transfer than per-topic top_k at measured
         # match rates); 'topk' = per-topic fixed-width slots
         self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
-        # sticky pow2 slot budgets for 'global' mode, PER padded batch size:
-        # one shared budget would let a 16K-topic batch (e.g. 128K slots)
-        # inflate every later 1-topic match's fetch to megabytes — the
-        # low-load p99 path must keep its own small budget
-        self._budgets: Dict[int, int] = {}
+        # sticky pow2 slot budgets for 'global' mode, PER (padded batch, NC)
+        # shape: one shared budget would let a 16K-topic batch (e.g. 128K
+        # slots) inflate every later 1-topic match's fetch to megabytes —
+        # the low-load p99 path must keep its own small budget
+        self._budgets: Dict[Tuple[int, int], int] = {}
+        # NC split-dispatch (RMQTT_NC_SPLIT=0 disables): bucket big batches
+        # by candidate count so padding chunks stop dominating device compute
+        self._split = os.environ.get("RMQTT_NC_SPLIT", "1") != "0"
         self._dev_version = -1
         self._dev_arrays = None
         self._pallas: Optional[bool] = None  # None = not decided yet
@@ -827,12 +858,14 @@ class PartitionedMatcher:
             from rmqtt_tpu.ops.pallas_match import match_words_pallas
 
             self._pallas_interpret = platform != "tpu"
-            got = np.asarray(
+            got = fetch(
                 match_words_pallas(dev, ttok, tlen, tdollar, chunk_ids,
-                                   interpret=self._pallas_interpret)
+                                   interpret=self._pallas_interpret),
+                "pallas verify fetch",
             )
             lax_fn = jax.jit(scan_words_impl)
-            want = np.asarray(lax_fn(dev, ttok, tlen, tdollar, chunk_ids))
+            want = fetch(lax_fn(dev, ttok, tlen, tdollar, chunk_ids),
+                         "lax verify fetch")
             if not np.array_equal(got, want):
                 log.warning("pallas match kernel disagrees with lax path; disabled")
                 if env != "1":
@@ -844,10 +877,13 @@ class PartitionedMatcher:
                 # unreliable on tunneled backends) and keep the faster one
                 def clock(fn, reps=3):
                     red = jax.jit(lambda *a: fn(*a).sum())
-                    int(red(dev, ttok, tlen, tdollar, chunk_ids))  # warm
+                    # fetch() keeps the wedge guard on these blocking reads
+                    int(fetch(red(dev, ttok, tlen, tdollar, chunk_ids),
+                              "pallas race warm fetch"))
                     t0 = time.perf_counter()
                     for _ in range(reps):
-                        int(red(dev, ttok, tlen, tdollar, chunk_ids))
+                        int(fetch(red(dev, ttok, tlen, tdollar, chunk_ids),
+                                  "pallas race fetch"))
                     return (time.perf_counter() - t0) / reps
 
                 t_pallas = clock(match_words_pallas)
@@ -947,10 +983,16 @@ class PartitionedMatcher:
         dev = self._refresh()
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
-            g = self._budgets.get(padded)
+            if words is None:
+                split = self._split_plan(chunk_ids, b)
+                if split is not None:
+                    return self._submit_split(
+                        dev, ttok, tlen, tdollar, chunk_ids, split
+                    )
+            g = self._budgets.get((padded, _nc))
             if g is None:
                 g = max(256, 1 << (4 * padded - 1).bit_length())
-                self._budgets[padded] = g
+                self._budgets[(padded, _nc)] = g
             if words is not None:
                 packed = _compact_global(words, budget=g)
                 grouped = None
@@ -979,13 +1021,137 @@ class PartitionedMatcher:
         return ("k", b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn,
                 self.max_words)
 
+    # ------------------------------------------------- NC split-dispatch
+    SPLIT_MIN_BATCH = 1024  # small batches are dispatch-bound, not compute
+
+    @staticmethod
+    def _tier_ladder(nc: int) -> Tuple[int, ...]:
+        """NC tiers: ~1.5×-step ladder (8, 12, 16, 24, 32, 48, …) capped
+        at nc. Measured batches concentrate in a NARROW count band just
+        under the sticky pow2 cap (cfg3: p50 14 / cap 32; cfg4: p50 45 /
+        cap 64 — NOTES r3), so coarse pow2 tiers capture nothing at the
+        top of the range; the 1.5 steps put a tier close above the band
+        (cfg3 → 16: scan halves; cfg4 → 48: scan −25%) while small-bucket
+        upward merging below keeps jit signatures few."""
+        tiers: List[int] = []
+        k = 0
+        while (8 << k) < nc:
+            tiers.append(8 << k)
+            if (12 << k) < nc:
+                tiers.append(12 << k)
+            k += 1
+        tiers.append(nc)
+        return tuple(tiers)
+
+    def _split_plan(self, chunk_ids: np.ndarray, b: int):
+        """Bucket the REAL topics (not the pow2 pad) by candidate count;
+        None when splitting can't save ≥25% of the scan work (the padding
+        rows each bucket re-adds are part of the estimate)."""
+        nc = chunk_ids.shape[1]
+        if not self._split or b < self.SPLIT_MIN_BATCH or nc <= 8:
+            return None
+        counts = (chunk_ids[:b] != 0).sum(axis=1)
+        tiers = np.asarray(self._tier_ladder(nc))
+        assign = np.searchsorted(tiers, counts)  # smallest tier ≥ count
+        sizes = np.bincount(assign, minlength=len(tiers))
+        # merge small buckets upward (a bucket in a bigger tier stays
+        # correct — extra columns are zero-padded): each non-empty bucket
+        # is one more scan in the combined jit signature, and a tiny one
+        # saves less compute than its compile + pow2 padding cost
+        floor = max(256, b // 16)
+        for i in range(len(tiers) - 1):
+            if 0 < sizes[i] < floor:
+                sizes[i + 1] += sizes[i]
+                sizes[i] = 0
+                assign[assign == i] = i + 1
+        est = sum(
+            (1 << (int(s) - 1).bit_length()) * int(t)
+            for s, t in zip(sizes, tiers) if s
+        )
+        if est * 4 >= b * nc * 3:
+            return None
+        order = np.argsort(assign, kind="stable")
+        return order, sizes, tuple(int(t) for t in tiers)
+
+    def _submit_split(self, dev, ttok, tlen, tdollar, chunk_ids, split):
+        order, sizes, tiers = split
+        b = len(order)
+        parts: List[Tuple] = []
+        meta: List[Tuple[int, int, int]] = []  # (nb, padded_b, tier)
+        budgets: List[int] = []
+        pos = 0
+        for tier, s in zip(tiers, sizes):
+            s = int(s)
+            if not s:
+                continue
+            idx = order[pos : pos + s]
+            pos += s
+            pb = 1 << (s - 1).bit_length() if s > 1 else 1
+            pt = np.zeros((pb, ttok.shape[1]), dtype=ttok.dtype)
+            pt[:s] = ttok[idx]
+            pl = np.full((pb,), -2, dtype=tlen.dtype)
+            pl[:s] = tlen[idx]
+            pd = np.zeros((pb,), dtype=bool)
+            pd[:s] = tdollar[idx]
+            # candidate lists are stored front-packed, so a count ≤ tier
+            # topic's chunks all live in the first `tier` columns
+            pc = np.zeros((pb, tier), dtype=chunk_ids.dtype)
+            pc[:s] = chunk_ids[idx, :tier]
+            g = self._budgets.get((pb, tier))
+            if g is None:
+                g = max(256, 1 << (4 * pb - 1).bit_length())
+                self._budgets[(pb, tier)] = g
+            parts.append((pt, pl, pd, pc))
+            meta.append((s, pb, tier))
+            budgets.append(g)
+        packed = _match_global_split(dev, tuple(parts), tuple(budgets))
+        return ("s", b, order, meta, parts, dev, packed, tuple(budgets))
+
+    def _complete_split(self, handle) -> List[np.ndarray]:
+        _tag, b, order, meta, parts, dev, packed, budgets = handle
+        fid_map = self.table._fid_of_row
+        while True:
+            arr = fetch(packed, "match result fetch")
+            segs: List[Tuple[np.ndarray, np.ndarray]] = []
+            regrow = list(budgets)
+            ok = True
+            o = 0
+            for bi, ((s, pb, tier), g) in enumerate(zip(meta, budgets)):
+                routes_seg = arr[o : o + g]
+                cn = arr[o + g : o + g + pb].astype(np.int64)
+                o += g + pb
+                segs.append((routes_seg, cn))
+                n = int(cn.sum())
+                if n > g:
+                    ok = False
+                    g2 = 1 << max(8, (n - 1).bit_length())
+                    regrow[bi] = g2
+                    self._budgets[(pb, tier)] = max(
+                        self._budgets.get((pb, tier), 0), g2
+                    )
+            if ok:
+                break
+            budgets = tuple(regrow)
+            packed = _match_global_split(dev, tuple(parts), budgets)
+        out: List[Optional[np.ndarray]] = [None] * b
+        pos = 0
+        for (s, pb, tier), part, (routes_seg, cn) in zip(meta, parts, segs):
+            n = int(cn.sum())
+            rows = _decode_routes(routes_seg[:n], cn, part[3], s, fid_map)
+            for orig, r in zip(order[pos : pos + s], rows):
+                out[orig] = r
+            pos += s
+        return out  # type: ignore[return-value]
+
     def match_complete(self, handle) -> List[np.ndarray]:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
+        if handle[0] == "s":
+            return self._complete_split(handle)
         if handle[0] == "g":
             return self._complete_global(handle)
         _tag, b, chunk_ids, words, dev_inputs, wi, wb, cn, kw = handle
         while True:
-            wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
+            wi, wb, cn = fetch(wi), fetch(wb), fetch(cn)
             if int(cn[:b].max(initial=0)) <= kw:
                 break
             # rare: re-run wider; sticky so later batches skip the narrow run
@@ -1023,19 +1189,19 @@ class PartitionedMatcher:
 
     def _complete_global(self, handle) -> List[np.ndarray]:
         _tag, b, chunk_ids, words, dev_inputs, packed, g = handle
-        padded = chunk_ids.shape[0]
+        padded, nc = chunk_ids.shape
         while True:
             # ONE fetch per match: [routes..., cnts...] (counts are
             # truncation-exact, so overflow is detectable from the same
             # array that carries the routes)
-            arr = np.asarray(packed)
+            arr = fetch(packed, "match result fetch")
             cn = arr[g:].astype(np.int64)
             n = int(cn.sum())
             if n <= g:
                 break
             g = 1 << max(8, (n - 1).bit_length())
-            # sticky pow2 regrow for this batch size
-            self._budgets[padded] = max(self._budgets.get(padded, 0), g)
+            # sticky pow2 regrow for this batch shape
+            self._budgets[(padded, nc)] = max(self._budgets.get((padded, nc), 0), g)
             if words is not None:
                 packed = _compact_global(words, budget=g)
             else:

@@ -20,6 +20,7 @@ import numpy as np
 
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import _match_retained, unpack_bitmap
+from rmqtt_tpu.utils.devfetch import fetch
 
 
 class RetainedScanner:
@@ -50,5 +51,5 @@ class RetainedScanner:
         b = len(filters)
         padded = 1 << (b - 1).bit_length() if (pad_to_pow2 and b > 1) else b
         enc = self.table.encode_filters(filters, pad_batch_to=padded)
-        packed = np.asarray(self.scan_encoded(*enc))
+        packed = fetch(self.scan_encoded(*enc), "retained scan fetch")
         return unpack_bitmap(packed[:b], nrows=self.table.capacity)

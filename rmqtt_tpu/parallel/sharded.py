@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import DEFAULT_CHUNK, match_packed_impl
+from rmqtt_tpu.utils.devfetch import fetch
 
 
 def make_mesh(devices=None, dp: int = 1, fp: Optional[int] = None) -> Mesh:
@@ -200,7 +201,7 @@ class ShardedPartitionedMatcher:
             return self._match_global(dev, inputs, chunk_ids, b, padded)
         while True:
             wi, wb, cn = _match_partitioned(dev, *inputs, max_words=self.max_words)
-            wi, wb, cn = np.asarray(wi), np.asarray(wb), np.asarray(cn)
+            wi, wb, cn = fetch(wi), fetch(wb), fetch(cn)
             if int(cn[:b].max(initial=0)) <= self.max_words:
                 break
             # rare overflow: re-run only the kernel, wider (inputs stay on
@@ -218,7 +219,7 @@ class ShardedPartitionedMatcher:
         bl = padded // self.ndev  # topics per device
         while True:
             # one fetch: per-device [routes(gd)... | cnts(bl)...], concatenated
-            arr = np.asarray(self._global_step(gd)(dev, *inputs))
+            arr = fetch(self._global_step(gd)(dev, *inputs), "sharded match fetch")
             per_dev = arr.reshape(self.ndev, gd + bl)
             cn = per_dev[:, gd:].astype(np.int64)  # [ndev, bl], shard-major
             totals = cn.sum(axis=1)

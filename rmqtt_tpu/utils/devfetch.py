@@ -1,0 +1,63 @@
+"""Timeout-guarded device→host fetches.
+
+On this hardware class the accelerator grant can wedge mid-run (NOTES.md):
+a ``np.asarray`` of a device array then blocks forever inside PJRT, taking
+the whole process with it — round 2's cfg5 bench died exactly there, losing
+every result already measured. When ``RMQTT_FETCH_TIMEOUT`` (seconds) is
+set, fetches run on a daemon worker thread and raise ``TimeoutError``
+instead of hanging, so callers (bench ``guarded()``, the routing service)
+can record the failure and continue/exit. Unset (the default, e.g. broker
+production paths on a healthy chip) it is a plain ``np.asarray`` — no
+thread, no overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_timeout: Optional[float] = None
+_loaded = False
+
+
+def fetch_timeout() -> Optional[float]:
+    global _timeout, _loaded
+    if not _loaded:
+        raw = os.environ.get("RMQTT_FETCH_TIMEOUT", "")
+        _timeout = float(raw) if raw else None
+        _loaded = True
+    return _timeout
+
+
+def set_fetch_timeout(seconds: Optional[float]) -> None:
+    global _timeout, _loaded
+    _timeout = seconds
+    _loaded = True
+
+
+def fetch(arr, what: str = "device fetch") -> np.ndarray:
+    """``np.asarray(arr)`` with the configured wedge guard."""
+    t = fetch_timeout()
+    if t is None:
+        return np.asarray(arr)
+    box: dict = {}
+
+    def run() -> None:
+        try:
+            box["v"] = np.asarray(arr)
+        except BaseException as e:  # surfaced on the caller thread
+            box["e"] = e
+
+    th = threading.Thread(target=run, daemon=True, name="devfetch")
+    th.start()
+    th.join(t)
+    if "v" in box:
+        return box["v"]
+    if "e" in box:
+        raise box["e"]
+    # the worker stays parked on the wedged fetch; daemon=True means it
+    # cannot block process exit
+    raise TimeoutError(f"{what} exceeded {t:.0f}s (wedged accelerator?)")
