@@ -142,7 +142,7 @@ def make_matcher(table):
     return TpuMatcher(table) if isinstance(table, FilterTable) else PartitionedMatcher(table)
 
 
-def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8, pipeline_depth=3):
+def measure_tpu(matcher, topics, batch_size, warmup=2, min_batches=8, pipeline_depth=3):
     """End-to-end topics/sec + per-batch latency through the batched matcher.
 
     Throughput is measured PIPELINED when the matcher supports
@@ -150,15 +150,28 @@ def measure_tpu(table, topics, batch_size, warmup=2, min_batches=8, pipeline_dep
     overlaps batch N's device compute — essential when dispatch latency is
     high, e.g. the ~68ms tunnel); latency percentiles come from serial
     round trips."""
-    matcher = make_matcher(table)
     batches = [topics[i : i + batch_size] for i in range(0, len(topics), batch_size)]
     batches = [b for b in batches if len(b) == batch_size]
     if len(batches) < warmup + min_batches:
         batches = batches * ((warmup + min_batches) // max(1, len(batches)) + 1)
     # warmup (compile)
     t0 = time.perf_counter()
-    for b in batches[:warmup]:
-        matcher.match(b)
+    try:
+        for b in batches[:warmup]:
+            matcher.match(b)
+    except Exception as e:
+        # round 2's cfg4 died here on-chip (10M-sub table → one huge
+        # device_put/compile → "TPU backend setup/compile error"): retry
+        # once with the table split into bounded segments before giving up
+        if not hasattr(matcher, "_seg_bytes") or matcher._segments is not None:
+            raise
+        log(f"  warmup failed ({type(e).__name__}: {e}); retrying with a "
+            f"segmented device table")
+        matcher._seg_bytes = min(matcher._seg_bytes, 128 << 20)
+        matcher._dev_version = -1
+        matcher._dev_arrays = None
+        for b in batches[:warmup]:
+            matcher.match(b)
     log(f"  tpu warmup/compile: {time.perf_counter() - t0:.2f}s")
     # latency: serial round trips on a few batches
     lat = []
@@ -259,9 +272,8 @@ def measure_cpu(tree, topics, sample, time_budget_s=20.0):
     }
 
 
-def spot_check(table, fids, tree, topics, n=32):
+def spot_check(matcher, fids, tree, topics, n=32):
     """Correctness: TPU fids ≡ trie values on a topic sample."""
-    matcher = make_matcher(table)
     sample = topics[:n]
     rows = matcher.match(sample)
     for topic, row in zip(sample, rows):
@@ -332,12 +344,15 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
         kinds = ("partitioned",)
     for kind in kinds:
         table, fids = build_tpu_table(filters, kind)
-        spot_check(table, fids, tree, topics)
+        # ONE matcher (and one device table upload) per variant: spot check,
+        # measurement and the retained interleave all share it
+        matcher = make_matcher(table)
+        spot_check(matcher, fids, tree, topics)
         with _device_profile(f"{name}_{kind}"):
-            variants[kind] = measure_tpu(table, topics, batch_size)
+            variants[kind] = measure_tpu(matcher, topics, batch_size)
             if retained is not None and kind == kinds[-1]:
-                variants["retained"] = run_retained(table, retained, topics)
-        del table, fids
+                variants["retained"] = run_retained(matcher, retained, topics)
+        del table, fids, matcher
     best_kind = max(kinds, key=lambda k: variants[k]["topics_per_sec"])
     tpu = variants[best_kind]
     # the honest baseline is the native (C++) trie when the toolchain exists
@@ -364,7 +379,7 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
     return res
 
 
-def run_retained(sub_table, retained_topics, publish_topics):
+def run_retained(matcher, retained_topics, publish_topics):
     """Config 5 extra: concurrent retained-scan (SUBSCRIBE) + publish routing."""
     from rmqtt_tpu.ops.encode import FilterTable
     from rmqtt_tpu.ops.retained import RetainedScanner
@@ -375,7 +390,6 @@ def run_retained(sub_table, retained_topics, publish_topics):
         rt.add(t)
     log(f"  retained table: {len(retained_topics)} topics in {time.perf_counter() - t0:.2f}s")
     scanner = RetainedScanner(rt)
-    matcher = make_matcher(sub_table)  # sub_table may be dense or partitioned
     # interleave: one publish batch + one subscribe-scan batch per round
     sub_filters = ["/".join(["+"] * k) + "/#" for k in range(1, 5)] * 16
     pb, sb = 1024, 64

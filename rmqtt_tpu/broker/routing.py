@@ -93,12 +93,12 @@ class RoutingService:
         # per dispatch costs more than the match itself and caps serial
         # publish throughput. Device routers keep the executor (the kernel
         # blocks; numpy/jax release the GIL for the heavy parts).
-        inline = self.router.prefer_inline
+        inline_ok = self.router.inline_ok
         while True:
             batch = await self._collect()
             items = [(fid, topic) for fid, topic, _, _ in batch]
             try:
-                if inline and len(items) <= 256:
+                if inline_ok(len(items)):
                     results = self.router.matches_batch_raw(items)
                 else:
                     results = await loop.run_in_executor(

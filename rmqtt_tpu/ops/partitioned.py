@@ -786,7 +786,15 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     halves again, and int16 compares run at twice the VPU lane density.
     flen/prefix_len (≤ L+1) and the 2-bit flags always fit.
     """
-    up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
+    if t.nchunks <= 16384:
+        up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
+    else:
+        # pow2 padding wastes up to half the array exactly where tables are
+        # huge (10M subs ≈ 83K chunks → a 131072 pad = 200MB of zero tiles,
+        # round 2's cfg4 compile-failure regime); above 16K chunks pad to a
+        # multiple of 4096 instead — at most one recompile per 4096-chunk
+        # growth, amortized at that scale
+        up_chunks = (t.nchunks + 4095) // 4096 * 4096
     rows = t.nchunks * CHUNK
     lvl = t.max_levels
     dt = np.int32 if t._tok_wide else np.int16
@@ -835,6 +843,13 @@ class PartitionedMatcher:
         self._dev_arrays = None
         self._pallas: Optional[bool] = None  # None = not decided yet
         self._pallas_interpret = False  # CPU (tests): run the kernel interpreted
+        # segmented-table mode: device tables above this byte budget split
+        # into multiple arrays scanned per segment (one huge device_put +
+        # compile at 10M subs is round 2's undiagnosed cfg4 on-chip failure;
+        # bounded arrays give that scale a working path either way)
+        self._seg_bytes = int(os.environ.get("RMQTT_SEG_BYTES", str(256 << 20)))
+        self._segments: Optional[List[Tuple[int, int, object]]] = None
+        self._seg_nc: Dict[int, int] = {}  # sticky per-segment NC cap
 
     def _decide_pallas(self, dev, ttok, tlen, tdollar, chunk_ids) -> bool:
         import logging
@@ -945,15 +960,52 @@ class PartitionedMatcher:
 
     def _refresh(self):
         t = self.table
-        if self._dev_version != t.version or self._dev_arrays is None:
+        if self._dev_version != t.version or (
+            self._dev_arrays is None and self._segments is None
+        ):
             put = (
                 functools.partial(jax.device_put, device=self.device)
                 if self.device
                 else jax.device_put
             )
-            self._dev_arrays = put(pack_device_rows(t))
+            packed = pack_device_rows(t)
+            if packed.nbytes > self._seg_bytes:
+                self._dev_arrays = None
+                self._segments = self._build_segments(packed, put)
+            else:
+                self._segments = None
+                self._dev_arrays = put(packed)
             self._dev_version = t.version
         return self._dev_arrays
+
+    def _build_segments(self, packed: np.ndarray, put):
+        """Split the packed table into ≤``_seg_bytes`` device arrays.
+
+        Segment 0 keeps the global chunk numbering (it contains the
+        reserved empty chunk 0); segment s>0 gets ONE zero chunk prepended
+        as its local padding target, so global chunk ``cid`` lives at local
+        ``cid - base + 1`` and a local match row maps back to the global
+        row space by the affine offset ``(base-1)*CHUNK`` (chunk 0 never
+        matches, so every real match has local chunk ≥ 1)."""
+        total = packed.shape[0]
+        nseg = -(-packed.nbytes // self._seg_bytes)
+        seg_chunks = -(-total // nseg)
+        # align for shape stability under growth; small alignment for small
+        # tables (tests force segmentation at toy scale via _seg_bytes)
+        align = 4096 if seg_chunks >= 4096 else (64 if seg_chunks >= 64 else 8)
+        seg_chunks = (seg_chunks + align - 1) // align * align
+        segs: List[Tuple[int, int, object]] = []
+        for base in range(0, total, seg_chunks):
+            part = packed[base : base + seg_chunks]
+            pads = [(0, 0)] * part.ndim
+            if base > 0:
+                pads[0] = (1, seg_chunks - part.shape[0])
+            else:
+                pads[0] = (0, seg_chunks - part.shape[0])
+            if any(p != (0, 0) for p in pads):
+                part = np.pad(part, pads)
+            segs.append((base, min(base + seg_chunks, total), put(part)))
+        return segs
 
     def match_submit(self, topics: Sequence[str], pad_to_pow2: bool = True):
         """Encode + dispatch WITHOUT fetching: jax dispatch is async, so the
@@ -981,35 +1033,38 @@ class PartitionedMatcher:
         )
         ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
         dev = self._refresh()
+        if self._segments is not None:
+            if self.compact_mode != "global":
+                raise NotImplementedError(
+                    "segmented tables support the 'global' compaction mode only"
+                )
+            return self._submit_segmented(ttok, tlen, tdollar, chunk_ids, b)
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
-            if words is None:
-                split = self._split_plan(chunk_ids, b)
-                if split is not None:
-                    return self._submit_split(
-                        dev, ttok, tlen, tdollar, chunk_ids, split
-                    )
-            g = self._budgets.get((padded, _nc))
-            if g is None:
-                g = max(256, 1 << (4 * padded - 1).bit_length())
-                self._budgets[(padded, _nc)] = g
             if words is not None:
+                g = self._budget_for(padded, _nc)
                 packed = _compact_global(words, budget=g)
-                grouped = None
+                return ("g", b, chunk_ids, words,
+                        (dev, ttok, tlen, tdollar, None), packed, g, 0)
+            split = self._split_plan(chunk_ids, b)
+            if split is not None:
+                return self._submit_split(
+                    dev, ttok, tlen, tdollar, chunk_ids, split, 0
+                )
+            grouped = self._group_inputs(enc[5], chunk_ids)
+            g = self._budget_for(padded, _nc)
+            if grouped is None:  # batch doesn't dedup; plain upload
+                packed = _match_global(
+                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                )
             else:
-                grouped = self._group_inputs(enc[5], chunk_ids)
-                if grouped is None:  # batch doesn't dedup; plain upload
-                    packed = _match_global(
-                        dev, ttok, tlen, tdollar, chunk_ids, budget=g
-                    )
-                else:
-                    packed = _match_global_grouped(
-                        dev, ttok, tlen, tdollar, *grouped, budget=g
-                    )
+                packed = _match_global_grouped(
+                    dev, ttok, tlen, tdollar, *grouped, budget=g
+                )
             # the handle carries ITS OWN budget: a sticky widening by a later
             # handle must not mask this one's truncation
             return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
-                    packed, g)
+                    packed, g, 0)
         wi, wb, cn = (
             _compact_words(words, max_words=self.max_words)
             if words is not None
@@ -1073,7 +1128,66 @@ class PartitionedMatcher:
         order = np.argsort(assign, kind="stable")
         return order, sizes, tuple(int(t) for t in tiers)
 
-    def _submit_split(self, dev, ttok, tlen, tdollar, chunk_ids, split):
+    def _budget_for(self, padded: int, nc: int) -> int:
+        g = self._budgets.get((padded, nc))
+        if g is None:
+            g = max(256, 1 << (4 * padded - 1).bit_length())
+            self._budgets[(padded, nc)] = g
+        return g
+
+    def _submit_segmented(self, ttok, tlen, tdollar, chunk_ids, b: int):
+        """One sub-handle per table segment: global candidate chunk ids are
+        remapped to segment-local ids (front-packed, trimmed to a sticky
+        per-segment NC), matched against the segment's device array, and
+        decoded through the segment's affine slice of the fid map."""
+        cid = chunk_ids.astype(np.int32, copy=False)
+        handles = []
+        for si, (base, end, dev) in enumerate(self._segments):
+            if base == 0:
+                loc = np.where(cid < end, cid, 0)
+                fid_base = 0
+            else:
+                loc = np.where((cid >= base) & (cid < end), cid - (base - 1), 0)
+                fid_base = (base - 1) * CHUNK
+            loc = _front_pack(loc)
+            mx = int((loc != 0).sum(axis=1).max(initial=1))
+            ncs = max(self._seg_nc.get(si, 8), 1 << (max(1, mx) - 1).bit_length())
+            self._seg_nc[si] = ncs
+            if loc.shape[1] >= ncs:
+                loc = loc[:, :ncs]
+            else:
+                loc = np.pad(loc, ((0, 0), (0, ncs - loc.shape[1])))
+            if loc.max(initial=0) < 0x10000:
+                loc = loc.astype(np.uint16)
+            split = self._split_plan(loc, b)
+            if split is not None:
+                handles.append(self._submit_split(
+                    dev, ttok, tlen, tdollar, loc, split, fid_base
+                ))
+                continue
+            padded = loc.shape[0]
+            g = self._budget_for(padded, ncs)
+            packed = _match_global(dev, ttok, tlen, tdollar, loc, budget=g)
+            handles.append(("g", b, loc, None, (dev, ttok, tlen, tdollar, None),
+                            packed, g, fid_base))
+        return ("M", b, handles)
+
+    def _complete_segmented(self, handle) -> List[np.ndarray]:
+        _tag, b, handles = handle
+        per_seg = [self.match_complete(h) for h in handles]
+        out: List[np.ndarray] = []
+        for i in range(b):
+            arrs = [s[i] for s in per_seg if len(s[i])]
+            if not arrs:
+                out.append(per_seg[0][i])
+            elif len(arrs) == 1:
+                out.append(arrs[0])
+            else:
+                out.append(np.sort(np.concatenate(arrs)))
+        return out
+
+    def _submit_split(self, dev, ttok, tlen, tdollar, chunk_ids, split,
+                      fid_base: int = 0):
         order, sizes, tiers = split
         b = len(order)
         parts: List[Tuple] = []
@@ -1105,11 +1219,13 @@ class PartitionedMatcher:
             meta.append((s, pb, tier))
             budgets.append(g)
         packed = _match_global_split(dev, tuple(parts), tuple(budgets))
-        return ("s", b, order, meta, parts, dev, packed, tuple(budgets))
+        return ("s", b, order, meta, parts, dev, packed, tuple(budgets), fid_base)
 
     def _complete_split(self, handle) -> List[np.ndarray]:
-        _tag, b, order, meta, parts, dev, packed, budgets = handle
+        _tag, b, order, meta, parts, dev, packed, budgets, fid_base = handle
         fid_map = self.table._fid_of_row
+        if fid_base:
+            fid_map = fid_map[fid_base:]
         while True:
             arr = fetch(packed, "match result fetch")
             segs: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -1145,6 +1261,8 @@ class PartitionedMatcher:
 
     def match_complete(self, handle) -> List[np.ndarray]:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
+        if handle[0] == "M":
+            return self._complete_segmented(handle)
         if handle[0] == "s":
             return self._complete_split(handle)
         if handle[0] == "g":
@@ -1188,7 +1306,7 @@ class PartitionedMatcher:
         return uniq_cand, inv.astype(inv_dt, copy=False)
 
     def _complete_global(self, handle) -> List[np.ndarray]:
-        _tag, b, chunk_ids, words, dev_inputs, packed, g = handle
+        _tag, b, chunk_ids, words, dev_inputs, packed, g, fid_base = handle
         padded, nc = chunk_ids.shape
         while True:
             # ONE fetch per match: [routes..., cnts...] (counts are
@@ -1214,12 +1332,21 @@ class PartitionedMatcher:
                     packed = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
-        return _decode_routes(
-            arr[:n], cn, chunk_ids, b, self.table._fid_of_row
-        )
+        fid_map = self.table._fid_of_row
+        if fid_base:
+            fid_map = fid_map[fid_base:]
+        return _decode_routes(arr[:n], cn, chunk_ids, b, fid_map)
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         return self.match_complete(self.match_submit(topics, pad_to_pow2))
+
+
+def _front_pack(a: np.ndarray) -> np.ndarray:
+    """Stable-move each row's nonzero entries to the front (zeros pad the
+    tail) — segment remapping punches holes in the front-packed candidate
+    lists, and the column trim below assumes front-packing."""
+    order = np.argsort(a == 0, axis=1, kind="stable")
+    return np.take_along_axis(a, order, axis=1)
 
 
 def _decode_batch(

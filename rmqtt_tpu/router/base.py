@@ -90,6 +90,12 @@ class Router(abc.ABC):
     # device-backed routers leave this False (their kernels block)
     prefer_inline: bool = False
 
+    def inline_ok(self, batch_size: int) -> bool:
+        """May this batch run on the event loop (µs-scale, non-blocking)?
+        Routers with a per-size fast path (XlaRouter's host-trie hybrid)
+        override this; the default follows ``prefer_inline``."""
+        return self.prefer_inline and batch_size <= 256
+
     @abc.abstractmethod
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
         """Register a subscription (filter already stripped of ``$share``)."""

@@ -139,3 +139,41 @@ def test_batched_matches_xla():
     assert flat(out[0]) == [(1, "a/+", "c1")]
     assert flat(out[1]) == [(1, "b/#", "c2")]
     assert flat(out[2]) == []
+
+
+def test_hybrid_small_batch_uses_side_trie_and_agrees():
+    """Sub-threshold batches answer from the host trie mirror (no device
+    dispatch), above-threshold from the matcher — identical results, and
+    removals keep the mirror in sync."""
+    import random
+
+    rng = random.Random(3)
+    x = XlaRouter()
+    assert x._side is not None
+    filters = [f"a/{i}/+" for i in range(40)] + ["a/#", "+/0/c", "b/+/#"]
+    for i, f in enumerate(filters):
+        x.add(f, Id(1, f"c{i}"), SubscriptionOptions(qos=0))
+    topics = [f"a/{rng.randrange(50)}/c" for _ in range(8)] + ["b/z/q", "zz"]
+    # force device-path comparison by spoofing the threshold
+    small = [x.matches_raw(None, t) for t in topics]
+    x2 = XlaRouter()
+    x2._hybrid_max = 0
+    x2._side = None
+    for i, f in enumerate(filters):
+        x2.add(f, Id(1, f"c{i}"), SubscriptionOptions(qos=0))
+    big = x2.matches_batch_raw([(None, t) for t in topics])
+    def norm(raw):
+        out, shared = raw
+        flat = sorted(
+            (r.topic_filter, r.id.client_id)
+            for rels in out.values() for r in rels
+        )
+        return flat, sorted(shared)
+    for t, s, b in zip(topics, small, big):
+        assert norm(s) == norm(b), t
+    # remove must update the mirror: a/# gone from both paths
+    x.remove("a/#", Id(1, "c40"))
+    for t in topics[:4]:
+        out, _sh = x.matches_raw(None, t)
+        assert all(r.topic_filter != "a/#" for rels in out.values() for r in rels), t
+    assert x.is_match("a/1/c") and not x.is_match("q/q/q/q")
