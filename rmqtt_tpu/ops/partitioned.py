@@ -969,10 +969,22 @@ class PartitionedMatcher:
                 else jax.device_put
             )
             packed = pack_device_rows(t)
-            if packed.nbytes > self._seg_bytes:
+            if packed.nbytes > self._seg_bytes and self.compact_mode == "global":
                 self._dev_arrays = None
                 self._segments = self._build_segments(packed, put)
             else:
+                if packed.nbytes > self._seg_bytes:
+                    # only the 'global' wire format supports segment merge;
+                    # a topk-mode table crossing the budget at runtime must
+                    # keep working (single array, round-2 behavior), not
+                    # start raising on every publish
+                    import logging
+
+                    logging.getLogger("rmqtt_tpu.ops").warning(
+                        "table %dMB exceeds RMQTT_SEG_BYTES but compact_mode"
+                        "=%r cannot segment; keeping one device array",
+                        packed.nbytes >> 20, self.compact_mode,
+                    )
                 self._segments = None
                 self._dev_arrays = put(packed)
             self._dev_version = t.version
@@ -1034,10 +1046,6 @@ class PartitionedMatcher:
         ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
         dev = self._refresh()
         if self._segments is not None:
-            if self.compact_mode != "global":
-                raise NotImplementedError(
-                    "segmented tables support the 'global' compaction mode only"
-                )
             return self._submit_segmented(ttok, tlen, tdollar, chunk_ids, b)
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
@@ -1150,8 +1158,13 @@ class PartitionedMatcher:
                 loc = np.where((cid >= base) & (cid < end), cid - (base - 1), 0)
                 fid_base = (base - 1) * CHUNK
             loc = _front_pack(loc)
-            mx = int((loc != 0).sum(axis=1).max(initial=1))
-            ncs = max(self._seg_nc.get(si, 8), 1 << (max(1, mx) - 1).bit_length())
+            mx = int((loc != 0).sum(axis=1).max(initial=0))
+            if mx == 0:
+                # no candidate in this segment for the whole batch: skip the
+                # kernel launch and result fetch entirely
+                handles.append(("E", b))
+                continue
+            ncs = max(self._seg_nc.get(si, 8), 1 << (mx - 1).bit_length())
             self._seg_nc[si] = ncs
             if loc.shape[1] >= ncs:
                 loc = loc[:, :ncs]
@@ -1172,9 +1185,14 @@ class PartitionedMatcher:
                             packed, g, fid_base))
         return ("M", b, handles)
 
+    _EMPTY_FIDS = np.empty(0, dtype=np.int64)
+
     def _complete_segmented(self, handle) -> List[np.ndarray]:
         _tag, b, handles = handle
-        per_seg = [self.match_complete(h) for h in handles]
+        per_seg = [
+            [self._EMPTY_FIDS] * b if h[0] == "E" else self.match_complete(h)
+            for h in handles
+        ]
         out: List[np.ndarray] = []
         for i in range(b):
             arrs = [s[i] for s in per_seg if len(s[i])]
@@ -1211,10 +1229,7 @@ class PartitionedMatcher:
             # topic's chunks all live in the first `tier` columns
             pc = np.zeros((pb, tier), dtype=chunk_ids.dtype)
             pc[:s] = chunk_ids[idx, :tier]
-            g = self._budgets.get((pb, tier))
-            if g is None:
-                g = max(256, 1 << (4 * pb - 1).bit_length())
-                self._budgets[(pb, tier)] = g
+            g = self._budget_for(pb, tier)
             parts.append((pt, pl, pd, pc))
             meta.append((s, pb, tier))
             budgets.append(g)

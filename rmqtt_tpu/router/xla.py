@@ -116,11 +116,13 @@ class XlaRouter(Router):
 
         self._hybrid_max = int(os.environ.get("RMQTT_HYBRID_MAX", "64"))
         self._side = None
+        self._side_native = False
         if self._hybrid_max > 0:
             try:
                 from rmqtt_tpu.runtime import NativeTrie
 
                 self._side = NativeTrie()
+                self._side_native = True
             except Exception:
                 from rmqtt_tpu.core.trie import TopicTree
 
@@ -132,7 +134,14 @@ class XlaRouter(Router):
             self._fid_to_filter[fid] = topic_filter
             self._filter_to_fid[topic_filter] = fid
             if self._side is not None:
-                self._side.add(topic_filter, fid)
+                if not self._side_native and len(self._fid_to_filter) > 200_000:
+                    # the Python-trie fallback mirror would duplicate a
+                    # million-filter table in dict nodes (GBs of host RAM)
+                    # for a fast path that no longer is one — drop it; the
+                    # device path serves every batch size
+                    self._side = None
+                else:
+                    self._side.add(topic_filter, fid)
 
     def remove(self, topic_filter: str, id: Id) -> bool:
         existed, empty = self._relations.remove(topic_filter, id)
@@ -145,9 +154,12 @@ class XlaRouter(Router):
         return existed
 
     def inline_ok(self, batch_size: int) -> bool:
-        # hybrid-served batches are host-trie µs-scale: run them on the
-        # event loop; device-bound batches keep the executor hop
-        return self._side is not None and batch_size <= self._hybrid_max
+        # hybrid-served batches on the C++ trie are µs-scale: run them on
+        # the event loop. The Python-tree fallback still answers small
+        # batches without a device round trip (matches_batch_raw), but its
+        # ms-scale DFS must keep the executor hop off the event loop.
+        return (self._side is not None and self._side_native
+                and batch_size <= self._hybrid_max)
 
     def matches_raw(self, from_id: Optional[Id], topic: str):
         return self.matches_batch_raw([(from_id, topic)])[0]
