@@ -39,6 +39,12 @@ class BrokerConfig:
     # PROXY protocol v1/v2 on the non-TLS listeners (builder.rs:152,466-474):
     # the advertised source replaces the socket peer address
     proxy_protocol: bool = False
+    # SO_REUSEPORT on the client listeners: multiple worker processes bind
+    # the same port and the kernel load-balances accepts — the multi-core
+    # analogue of the reference's multi-thread tokio accept loops
+    # (server.rs:229); workers peer over the cluster layer for cross-worker
+    # delivery (see broker/__main__.py --workers)
+    reuse_port: bool = False
     node_id: int = 1
     router: str = "trie"  # "trie" (DefaultRouter) | "xla" (TPU)
     allow_anonymous: bool = True

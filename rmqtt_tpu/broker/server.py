@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import sys
+import time
 import uuid
 from typing import Optional
 
@@ -101,7 +103,10 @@ class MqttBroker:
         self.ctx.start()
         await self.ctx.plugins.start_all()
         cfg = self.ctx.cfg
-        self._server = await asyncio.start_server(self._on_connection, cfg.host, cfg.port)
+        rp = {"reuse_port": True} if cfg.reuse_port else {}
+        self._server = await asyncio.start_server(
+            self._on_connection, cfg.host, cfg.port, **rp
+        )
         log.info("listening on %s:%s", cfg.host, self.port)
         sslctx = None
         if cfg.tls_port is not None or cfg.wss_port is not None:
@@ -120,17 +125,17 @@ class MqttBroker:
                 sslctx.verify_mode = ssl.CERT_REQUIRED
         if cfg.ws_port is not None:
             self._ws_server = await asyncio.start_server(
-                self._on_ws_connection, cfg.host, cfg.ws_port
+                self._on_ws_connection, cfg.host, cfg.ws_port, **rp
             )
             log.info("ws listening on %s:%s", cfg.host, self.ws_port)
         if cfg.tls_port is not None and sslctx:
             self._tls_server = await asyncio.start_server(
-                self._on_connection, cfg.host, cfg.tls_port, ssl=sslctx
+                self._on_connection, cfg.host, cfg.tls_port, ssl=sslctx, **rp
             )
             log.info("tls listening on %s:%s", cfg.host, self.tls_port)
         if cfg.wss_port is not None and sslctx:
             self._wss_server = await asyncio.start_server(
-                self._on_ws_connection, cfg.host, cfg.wss_port, ssl=sslctx
+                self._on_ws_connection, cfg.host, cfg.wss_port, ssl=sslctx, **rp
             )
             log.info("wss listening on %s:%s", cfg.host, self.wss_port)
 
@@ -480,6 +485,8 @@ async def _amain(args) -> None:
         # "<node_id>@<host>:<port>" (reference NodeAddr format,
         # rmqtt-utils/src/lib.rs:121); CLI peers replace file peers
         cli.setdefault("cluster", {})["peers"] = list(args.peer)
+    if args.reuse_port:
+        cli.setdefault("listener", {})["reuse_port"] = True
     settings = conf.load(args.config, cli=cli)
     broker = MqttBroker(ServerContext(settings.broker))
     conf.instantiate_plugins(broker.ctx, settings)
@@ -498,7 +505,8 @@ async def _amain(args) -> None:
             cluster = BroadcastCluster(broker.ctx, settings.cluster_listen, settings.peers)
         await cluster.start()
     api = None
-    if settings.http_api:
+    if settings.http_api and not getattr(args, "no_http_api", False):
+        # under --workers only worker 1 serves the admin API (one port)
         from rmqtt_tpu.broker.http_api import HttpApi
 
         api = HttpApi(broker.ctx, **settings.http_api)
@@ -513,6 +521,87 @@ async def _amain(args) -> None:
         )
     async with broker._server:
         await broker._server.serve_forever()
+
+
+def _supervise_workers(args, argv: list) -> None:
+    """--workers N: spawn N broker processes sharing the client port via
+    SO_REUSEPORT (kernel load-balances accepts — the multi-core analogue of
+    the reference's multi-thread tokio accept loop, server.rs:229), peered
+    as a localhost broadcast cluster for cross-worker delivery. Worker i
+    gets node id i+1 and cluster RPC port base+i; only worker 1 serves the
+    admin API. The supervisor forwards SIGTERM/SIGINT and exits when any
+    worker dies (a clean, signal-initiated stop exits 0)."""
+    import signal
+    import subprocess
+
+    if args.cluster_mode or args.cluster_listen or args.node_id or args.peer:
+        sys.exit("--workers manages node ids and the cluster itself; it "
+                 "cannot combine with --cluster-mode/--cluster-listen/"
+                 "--node-id/--peer")
+    n = args.workers
+    if args.cluster_port_base:
+        base = args.cluster_port_base
+    else:
+        # the client port may come from the config file, not the CLI —
+        # resolve the effective port before deriving RPC ports off it
+        from rmqtt_tpu import conf
+
+        cli = {"listener": {"port": args.port}} if args.port is not None else {}
+        base = conf.load(args.config, cli=cli).broker.port + 1000
+    passthrough = []
+    skip = 0
+    for a in argv:
+        if skip:
+            skip -= 1
+            continue
+        if a in ("--workers", "--cluster-port-base"):
+            skip = 1
+            continue
+        if a.startswith("--workers=") or a.startswith("--cluster-port-base="):
+            continue
+        passthrough.append(a)
+    procs = []
+    for i in range(n):
+        cmd = [sys.executable, "-m", "rmqtt_tpu.broker", *passthrough,
+               "--reuse-port", "--node-id", str(i + 1),
+               "--cluster-listen", f"127.0.0.1:{base + i}",
+               "--cluster-mode", "broadcast"]
+        for j in range(n):
+            if j != i:
+                cmd += ["--peer", f"{j + 1}@127.0.0.1:{base + j}"]
+        if i > 0:
+            cmd.append("--no-http-api")
+        procs.append(subprocess.Popen(cmd))
+    stopping = False
+
+    def stop(_sig, _frm):
+        nonlocal stopping
+        stopping = True
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+
+    signal.signal(signal.SIGTERM, stop)
+    signal.signal(signal.SIGINT, stop)
+    rc = 0
+    try:
+        while procs:
+            for p in list(procs):
+                r = p.poll()
+                if r is not None:
+                    procs.remove(p)
+                    if not stopping:
+                        # an unrequested worker death degrades the whole
+                        # listener group: stop the rest (restart policy is
+                        # external, e.g. systemd)
+                        rc = rc or (r if r > 0 else 1)
+                        stopping = True
+                        for q in procs:
+                            q.send_signal(signal.SIGTERM)
+            time.sleep(0.3)
+    finally:
+        for p in procs:
+            p.wait()
+    sys.exit(rc)
 
 
 def main() -> None:
@@ -530,9 +619,22 @@ def main() -> None:
         "--peer", action="append", default=[],
         help="peer node as <node_id>@<host>:<port>; repeatable",
     )
+    ap.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the client port via SO_REUSEPORT",
+    )
+    ap.add_argument("--reuse-port", action="store_true",
+                    help="set SO_REUSEPORT on the client listeners")
+    ap.add_argument("--cluster-port-base", type=int, default=None,
+                    help="first cluster RPC port for --workers (default port+1000)")
+    ap.add_argument("--no-http-api", action="store_true",
+                    help="do not start the admin HTTP API in this process")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    if args.workers and args.workers > 1:
+        _supervise_workers(args, sys.argv[1:])
+        return
     asyncio.run(_amain(args))
 
 

@@ -111,6 +111,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "tls_key": listener.get("tls_key", ""),
         "tls_client_ca": listener.get("tls_client_ca", ""),
         "proxy_protocol": bool(listener.get("proxy_protocol", False)),
+        "reuse_port": bool(listener.get("reuse_port", False)),
         "node_id": int(node.get("id", 1)),
         "router": node.get("router", "trie"),
         "fitter": fitter,

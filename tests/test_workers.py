@@ -1,0 +1,75 @@
+"""--workers N: SO_REUSEPORT worker processes as a localhost broadcast
+cluster (multi-core host data plane; reference scales via a multi-thread
+tokio accept loop, `/root/reference/rmqtt/src/server.rs:229`)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _pkt(t, payload):
+    return bytes([t, len(payload)]) + payload
+
+
+def _connect(port, cid):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + len(cid).to_bytes(2, "big") + cid
+    s.sendall(_pkt(0x10, vh))
+    assert s.recv(4)[0] == 0x20
+    return s
+
+
+@pytest.mark.timeout(90)
+def test_two_workers_share_port_and_deliver_across():
+    port = 18861
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(port),
+         "--workers", "2", "--cluster-port-base", str(port + 500)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(160):
+            try:
+                _connect(port, b"probe").close()
+                break
+            except OSError:
+                time.sleep(0.25)
+        else:
+            pytest.fail("workers never came up")
+        time.sleep(1.5)  # workers peer up
+        subs = []
+        for i in range(16):
+            s = _connect(port, b"s%d" % i)
+            s.sendall(_pkt(0x82, b"\x00\x01\x00\x07sport/+\x00"))
+            assert s.recv(5)[0] == 0x90
+            s.settimeout(8)
+            subs.append(s)
+        pubs = [_connect(port, b"p%d" % i) for i in range(4)]
+        t = b"sport/news"
+        for i, p in enumerate(pubs):
+            p.sendall(_pkt(0x30, len(t).to_bytes(2, "big") + t + b"m%d" % i))
+        got = 0
+        for s in subs:
+            buf = b""
+            deadline = time.time() + 10
+            while buf.count(b"sport/news") < len(pubs) and time.time() < deadline:
+                try:
+                    buf += s.recv(4096)
+                except socket.timeout:
+                    break
+            got += buf.count(b"sport/news")
+        assert got == len(subs) * len(pubs), f"only {got} deliveries"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
