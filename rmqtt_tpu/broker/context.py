@@ -113,6 +113,10 @@ class ServerContext:
             router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
         )
         self.retain = RetainStore(enable=self.cfg.retain_enable, max_retained=self.cfg.retain_max)
+        # MessageManager seam (message.rs:61-147): the message-storage
+        # plugin installs itself here; None = storage disabled (the
+        # reference's DefaultMessageManager no-op, message.rs:148-164)
+        self.message_mgr = None
         if self.cfg.cluster and self.cfg.cluster_mode == "raft":
             from rmqtt_tpu.cluster.raft_mode import RaftSessionRegistry
 

@@ -81,6 +81,50 @@ def subscription_rows(ctx, limit: int) -> list:
     return out
 
 
+def subscription_search(ctx, params: dict) -> list:
+    """Filtered subscription query (reference SubsSearchParams/Result,
+    types.rs:2014 + grpc.rs SubscriptionsSearch): match on client id,
+    exact topic filter, QoS and share group; bounded by ``_limit``."""
+    limit = int(params.get("_limit", 100))
+    want_cid = params.get("clientid")
+    want_tf = params.get("topic")
+    want_qos = params.get("qos")
+    want_share = params.get("share")
+    out = []
+    for s in ctx.registry.sessions():
+        if want_cid is not None and s.client_id != want_cid:
+            continue
+        for tf, opts in s.subscriptions.items():
+            if len(out) >= limit:
+                return out
+            if want_tf is not None and tf != want_tf:
+                continue
+            if want_qos is not None and opts.qos != int(want_qos):
+                continue
+            if want_share is not None and opts.shared_group != want_share:
+                continue
+            out.append({
+                "client_id": s.client_id, "node_id": s.id.node_id,
+                "topic_filter": tf, "qos": opts.qos, "share": opts.shared_group,
+            })
+    return out
+
+
+def routes_by_topic(ctx, topic: str) -> list:
+    """Distinct (topic_filter, node) routes a publish to ``topic`` would
+    take (reference RoutesGetBy, grpc.rs:529 + router.rs `gets` by topic):
+    a trie match with subscriber fan-out collapsed to route edges."""
+    relmap, shared = ctx.router.matches_raw(None, topic)
+    edges = set()
+    for node_id, rels in relmap.items():
+        for rel in rels:
+            edges.add((rel.topic_filter, rel.id.node_id))
+    for (_group, tf), cands in shared.items():
+        for sid, _opts, _online in cands:
+            edges.add((tf, sid.node_id))
+    return [{"topic": tf, "node_id": nid} for tf, nid in sorted(edges)]
+
+
 async def _cluster_merge(ctx, mtype: str, body, extract) -> list:
     """Fan an admin query out to peers and merge rows (the reference's
     http-api gRPC broadcast, rmqtt-http-api/src/handler.rs)."""
@@ -204,6 +248,14 @@ class HttpApi:
                     await ctx.registry.terminate(s, "api-kick")
                 return 200, {"kicked": cid}, J
             return 200, client_info(s), J
+        if path == "/api/v1/subscriptions/search":
+            params = {k: v[0] for k, v in q.items()}
+            rows = subscription_search(ctx, params)
+            rows += await _cluster_merge(
+                ctx, M.SUBSCRIPTIONS_SEARCH, params,
+                lambda r: r.get("subscriptions", []),
+            )
+            return 200, rows[: int(params.get("_limit", 100))], J
         if path == "/api/v1/subscriptions":
             limit = int(q.get("_limit", ["100"])[0])
             rows = subscription_rows(ctx, limit)
@@ -212,9 +264,23 @@ class HttpApi:
                 lambda r: r.get("subscriptions", []),
             )
             return 200, rows[: limit], J
+        if path.startswith("/api/v1/routes/"):
+            # routes a publish to this topic would take (api.rs routes/{topic})
+            topic = path[len("/api/v1/routes/"):]
+            rows = routes_by_topic(ctx, topic)
+            rows += await _cluster_merge(
+                ctx, M.ROUTES_GET_BY, {"topic": topic},
+                lambda r: r.get("routes", []),
+            )
+            dedup = {(r["topic"], r["node_id"]) for r in rows}
+            return 200, [{"topic": t, "node_id": n} for t, n in sorted(dedup)], J
         if path == "/api/v1/routes":
             limit = int(q.get("_limit", ["100"])[0])
-            return 200, ctx.router.gets(limit), J
+            rows = ctx.router.gets(limit)
+            rows += await _cluster_merge(
+                ctx, M.ROUTES_GET, {"limit": limit}, lambda r: r.get("routes", [])
+            )
+            return 200, rows[: limit], J
         if path == "/api/v1/stats":
             nodes = [{"node": ctx.node_id, "stats": ctx.stats().to_json()}]
             nodes += await _cluster_merge(

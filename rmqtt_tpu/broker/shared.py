@@ -186,4 +186,10 @@ class SessionRegistry:
                 sub_ids=opts.subscription_ids,
             )
         )
+        # live delivery counts as forwarded for the message store, so a
+        # later subscribe-time replay skips it (shared.rs:751-760)
+        if msg.stored_id is not None:
+            mgr = getattr(self.ctx, "message_mgr", None)
+            if mgr is not None:
+                mgr.mark_forwarded(msg.stored_id, client_id)
         return 1

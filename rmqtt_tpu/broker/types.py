@@ -34,6 +34,10 @@ class Message:
     from_id: Optional[Id] = None
     target_clientid: Optional[str] = None  # p2p short-circuit (types.rs)
     delay_interval: Optional[int] = None  # $delayed publishes
+    # id assigned by the message store when persisted (reference msg_id,
+    # message.rs:71); travels with ForwardsTo so receiving nodes can ack
+    # delivery for mark-forwarded bookkeeping (shared.rs:596-613)
+    stored_id: Optional[int] = None
 
     def is_expired(self, at: Optional[float] = None) -> bool:
         if self.expiry_interval is None:

@@ -36,7 +36,21 @@ log = logging.getLogger("rmqtt_tpu.cluster")
 _UNHANDLED = object()
 
 
-async def handle_common_message(ctx, mtype: str, body) -> object:
+def _bg_notify(cluster, peer, mtype: str, body) -> None:
+    """Fire-and-forget peer notify from a handler (strong-ref'd task)."""
+
+    async def push():
+        try:
+            await peer.notify(mtype, body)
+        except PeerUnavailable:
+            log.warning("%s to node %s failed", mtype, peer.node_id)
+
+    task = asyncio.get_running_loop().create_task(push())
+    cluster._bg_tasks.add(task)
+    task.add_done_callback(cluster._bg_tasks.discard)
+
+
+async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=None) -> object:
     """RPC handlers shared by broadcast and raft modes (ForwardsTo, Kick,
     retain sync, counters, liveness). Returns ``_UNHANDLED`` for
     mode-specific types."""
@@ -49,10 +63,37 @@ async def handle_common_message(ctx, mtype: str, body) -> object:
             target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
             return {"count": 1}
         count = 0
+        recipients: List[str] = []
         for rw in body["rels"]:
             rel = M.relation_from_wire(rw)
-            count += ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+            if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
+                count += 1
+                recipients.append(rel.id.client_id)
+        # fire-and-forget mark-forwarded ack back to the publishing node
+        # (cluster-raft/src/shared.rs:596-613 ForwardsToAck); the sender's
+        # node id rides in the body (the transport has no peer identity)
+        sender = body.get("from_node", from_node)
+        if msg.stored_id is not None and recipients and cluster is not None:
+            peer = cluster.peers.get(sender)
+            if peer is not None:
+                _bg_notify(cluster, peer, M.FORWARDS_TO_ACK,
+                           {"sid": msg.stored_id, "recipients": recipients})
         return {"count": count}
+    if mtype == M.FORWARDS_TO_ACK:
+        mgr = getattr(ctx, "message_mgr", None)
+        if mgr is not None:
+            for cid in body.get("recipients", []):
+                mgr.mark_forwarded(body["sid"], cid)
+        return None
+    if mtype == M.MESSAGE_GET:
+        # merge_on_read fetch (cluster-raft/src/shared.rs:665-699): return
+        # this node's unforwarded stored matches, marking them so the
+        # requesting node's replay can't repeat on a later subscribe
+        mgr = getattr(ctx, "message_mgr", None)
+        if mgr is None:
+            return {"msgs": []}
+        rows = mgr.load_unforwarded(body["filter"], body["client_id"], mark=True)
+        return {"msgs": [[sid, M.msg_to_wire(m)] for sid, m in rows]}
     if mtype == M.KICK:
         session = ctx.registry.get(body["client_id"])
         if session is not None:
@@ -103,6 +144,16 @@ async def handle_common_message(ctx, mtype: str, body) -> object:
         from rmqtt_tpu.broker.http_api import subscription_rows
 
         return {"subscriptions": subscription_rows(ctx, int(body.get("limit", 100)))}
+    if mtype == M.SUBSCRIPTIONS_SEARCH:
+        from rmqtt_tpu.broker.http_api import subscription_search
+
+        return {"subscriptions": subscription_search(ctx, body or {})}
+    if mtype == M.ROUTES_GET:
+        return {"routes": ctx.router.gets(int(body.get("limit", 100)))}
+    if mtype == M.ROUTES_GET_BY:
+        from rmqtt_tpu.broker.http_api import routes_by_topic
+
+        return {"routes": routes_by_topic(ctx, body["topic"])}
     if mtype == M.CLIENTS_GET:
         from rmqtt_tpu.broker.http_api import client_info
 
@@ -188,16 +239,22 @@ class ClusterSessionRegistry(ClusterRegistryBase):
         # 1) local: deliver non-shared, collect shared candidates
         raw = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
         relmap, shared = raw
-        count = self._deliver_relmap(relmap, msg)
+        count, _ = self._deliver_relmap(relmap, msg)
         # 2) scatter: peers deliver their non-shared and reply candidates
         replies = await cluster.bcast.join_all_call(
             M.FORWARDS, {"msg": M.msg_to_wire(msg)}
         )
+        mgr = getattr(self.ctx, "message_mgr", None)
         merged: Dict[Tuple[str, str], list] = {k: list(v) for k, v in shared.items()}
         for node_id, reply in replies:
             if isinstance(reply, Exception):
                 continue
             count += int(reply.get("count", 0))
+            # remote live deliveries count as forwarded in this node's store
+            # (the broadcast-mode analogue of ForwardsToAck bookkeeping)
+            if mgr is not None and msg.stored_id is not None:
+                for cid in reply.get("recipients", []):
+                    mgr.mark_forwarded(msg.stored_id, cid)
             for key, cands in _cands_from_wire(reply.get("shared", [])).items():
                 merged.setdefault(key, []).extend(cands)
         # 3) global shared-group choice (src/shared.rs:516-560)
@@ -221,6 +278,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     "msg": M.msg_to_wire(msg),
                     "rels": [M.relation_to_wire(r) for r in rels],
                     "p2p": None,
+                    "from_node": self.ctx.node_id,
                 })
                 count += len(rels)
                 self.ctx.metrics.inc("cluster.forwards")
@@ -228,12 +286,15 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                 log.warning("ForwardsTo to node %s failed", node_id)
         return count
 
-    def _deliver_relmap(self, relmap, msg: Message) -> int:
+    def _deliver_relmap(self, relmap, msg: Message) -> Tuple[int, List[str]]:
         count = 0
+        recipients: List[str] = []
         for _node, rels in relmap.items():
             for rel in rels:
-                count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
-        return count
+                if self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
+                    count += 1
+                    recipients.append(rel.id.client_id)
+        return count, recipients
 
 class BroadcastCluster:
     def __init__(
@@ -306,9 +367,10 @@ class BroadcastCluster:
             msg = M.msg_from_wire(body["msg"])
             raw = await ctx.routing.matches_raw(msg.from_id, msg.topic)
             relmap, shared = raw
-            count = ctx.registry._deliver_relmap(relmap, msg)
-            return {"count": count, "shared": _cands_to_wire(shared)}
-        res = await handle_common_message(ctx, mtype, body)
+            count, recipients = ctx.registry._deliver_relmap(relmap, msg)
+            return {"count": count, "shared": _cands_to_wire(shared),
+                    "recipients": recipients if msg.stored_id is not None else []}
+        res = await handle_common_message(ctx, mtype, body, cluster=self, from_node=_from_node)
         if res is not _UNHANDLED:
             return res
         raise ValueError(f"unknown cluster message {mtype!r}")

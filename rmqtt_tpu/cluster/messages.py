@@ -17,6 +17,7 @@ from rmqtt_tpu.router.base import Id, SubRelation, SubscriptionOptions
 # message type tags (grpc.rs Message variants)
 FORWARDS = "forwards"
 FORWARDS_TO = "forwards_to"
+FORWARDS_TO_ACK = "forwards_to_ack"  # mark-forwarded bookkeeping (shared.rs:596-613)
 KICK = "kick"
 GET_RETAINS = "get_retains"
 SET_RETAIN = "set_retain"
@@ -25,9 +26,12 @@ NUMBER_OF_SESSIONS = "number_of_sessions"
 ONLINE = "online"
 SESSION_STATUS = "session_status"
 SUBSCRIPTIONS_GET = "subscriptions_get"
+SUBSCRIPTIONS_SEARCH = "subscriptions_search"  # grpc.rs SubscriptionsSearch
 CLIENTS_GET = "clients_get"
 STATS_GET = "stats_get"
 ROUTES_GET = "routes_get"
+ROUTES_GET_BY = "routes_get_by"  # grpc.rs RoutesGetBy(Topic)
+MESSAGE_GET = "message_get"  # cross-node stored-message fetch (merge_on_read)
 PING = "ping"
 DATA = "data"
 
@@ -47,6 +51,7 @@ def msg_to_wire(m: Message) -> dict:
         "exp": m.expiry_interval,
         "from": [m.from_id.node_id, m.from_id.client_id] if m.from_id else None,
         "target": m.target_clientid,
+        "sid": m.stored_id,
     }
 
 
@@ -68,6 +73,7 @@ def msg_from_wire(d: dict) -> Message:
         expiry_interval=d["exp"],
         from_id=Id(frm[0], frm[1]) if frm else None,
         target_clientid=d.get("target"),
+        stored_id=d.get("sid"),
     )
 
 

@@ -173,6 +173,7 @@ class RaftSessionRegistry(ClusterRegistryBase):
                     "msg": M.msg_to_wire(msg),
                     "rels": [M.relation_to_wire(r) for r in rels],
                     "p2p": None,
+                    "from_node": self.ctx.node_id,
                 })
                 count += len(rels)
                 self.ctx.metrics.inc("cluster.forwards")
@@ -376,7 +377,9 @@ class RaftCluster:
         await self.ctx.hooks.fire(HookType.GRPC_MESSAGE_RECEIVED, mtype, _from_node, None)
         if mtype == M.PING:
             return {"pong": True, "leader": self.raft.leader_id, "term": self.raft.term}
-        res = await handle_common_message(self.ctx, mtype, body)
+        res = await handle_common_message(
+            self.ctx, mtype, body, cluster=self, from_node=_from_node
+        )
         if res is not _UNHANDLED:
             return res
         raise ValueError(f"unknown cluster message {mtype!r}")

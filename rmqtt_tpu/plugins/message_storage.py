@@ -5,17 +5,27 @@ trait (`rmqtt/src/message.rs:61-147`): published messages are stored with an
 expiry; when a client subscribes, stored messages matching the new filter
 are replayed unless already forwarded to that client (``mark_forwarded``,
 used by `rmqtt/src/shared.rs:751-760` to prevent redelivery).
+
+Cluster semantics (``merge_on_read``, `rmqtt/src/message.rs:73` +
+`rmqtt-cluster-raft/src/shared.rs:665-699`): the store is node-local — a
+publish is stored only where it arrived — so ``message_load`` on subscribe
+additionally broadcasts ``MessageGet`` to peers and merges their unforwarded
+matches. Cross-node live delivery is reconciled by ``ForwardsToAck``
+(`shared.rs:596-613`): the receiving node acks (stored_id, recipients) back
+to the publishing node, which marks them forwarded here.
 """
 
 from __future__ import annotations
 
-import asyncio
 import itertools
 import time
-from typing import Optional
+from typing import List, Optional, Tuple
 
-from rmqtt_tpu.broker.hooks import HookType
+import dataclasses as dc
+
+from rmqtt_tpu.broker.hooks import HookResult, HookType
 from rmqtt_tpu.broker.session import DeliverItem
+from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.cluster.messages import msg_from_wire, msg_to_wire
 from rmqtt_tpu.core.topic import match_filter, parse_shared
 from rmqtt_tpu.plugins import Plugin
@@ -34,22 +44,70 @@ class MessageStoragePlugin(Plugin):
         self.store = SqliteStore(self.config.get("path", ":memory:"))
         self.default_expiry = float(self.config.get("expiry", 300.0))
         self.max_stored = int(self.config.get("max_stored", 100_000))
-        self._msg_id = itertools.count(int(time.time() * 1000))
+        # merge_on_read (message.rs:73): pull stored messages from peers at
+        # subscribe time instead of replicating the store
+        self.merge_on_read = bool(self.config.get("merge_on_read", True))
+        self._msg_id = itertools.count(
+            int(time.time() * 1000) * 1000 + (ctx.node_id % 1000)
+        )
         self._unhooks = []
 
+    # ---------------------------------------------- MessageManager surface
+    def store_msg(self, msg: Message) -> Optional[int]:
+        """Persist one publish; returns its stored id (message.rs `store`)."""
+        if self.store.count(NS_MSG) >= self.max_stored:
+            return None
+        sid = next(self._msg_id)
+        ttl = msg.expiry_interval or self.default_expiry
+        self.store.put(NS_MSG, str(sid), msg_to_wire(msg), ttl=ttl)
+        self.ctx.metrics.inc("storage.messages_stored")
+        return sid
+
+    def mark_forwarded(self, stored_id: int, client_id: str) -> None:
+        """Record delivery so subscribe-time replay skips it
+        (message.rs `mark_forwarded`; called from the live fan-out like
+        shared.rs:751-760, and from cross-node ForwardsToAck)."""
+        self.store.put(
+            NS_FWD, f"{stored_id}\x00{client_id}", True, ttl=self.default_expiry
+        )
+
+    def load_unforwarded(
+        self, stripped_filter: str, client_id: str, mark: bool = False
+    ) -> List[Tuple[int, Message]]:
+        """Stored, unexpired messages matching ``stripped_filter`` not yet
+        forwarded to ``client_id`` (message.rs `get`). With ``mark`` the
+        returned batch is immediately marked forwarded — the MessageGet RPC
+        handler uses this so a remote replay can't repeat."""
+        out: List[Tuple[int, Message]] = []
+        for msg_id, mw in self.store.scan(NS_MSG):
+            if self.store.get(NS_FWD, f"{msg_id}\x00{client_id}") is not None:
+                continue
+            msg = msg_from_wire(mw)
+            if msg.is_expired() or not match_filter(stripped_filter, msg.topic):
+                continue
+            out.append((int(msg_id), msg))
+            if mark:
+                self.mark_forwarded(int(msg_id), client_id)
+        return out
+
+    def count(self) -> int:
+        return self.store.count(NS_MSG)
+
+    # -------------------------------------------------------------- hooks
     async def init(self) -> None:
         hooks = self.ctx.hooks
+        self.ctx.message_mgr = self
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
             if msg.topic.startswith("$"):
                 return None
-            if self.store.count(NS_MSG) >= self.max_stored:
+            sid = self.store_msg(msg)
+            if sid is None:
                 return None
-            ttl = msg.expiry_interval or self.default_expiry
-            self.store.put(NS_MSG, str(next(self._msg_id)), msg_to_wire(msg), ttl=ttl)
-            self.ctx.metrics.inc("storage.messages_stored")
-            return None
+            # the stored id rides the Message through the fan-out so local
+            # delivery and remote acks can mark-forward against this store
+            return HookResult(value=dc.replace(msg, stored_id=sid))
 
         async def on_subscribed(_ht, args, _prev):
             id, full_filter = args[0], args[1]
@@ -60,18 +118,33 @@ class MessageStoragePlugin(Plugin):
                 _g, stripped = parse_shared(full_filter)
             except ValueError:
                 return None
-            for msg_id, mw in self.store.scan(NS_MSG):
-                fwd_key = f"{msg_id}\x00{id.client_id}"
-                if self.store.get(NS_FWD, fwd_key) is not None:
-                    continue  # mark_forwarded dedup
-                msg = msg_from_wire(mw)
-                if msg.is_expired() or not match_filter(stripped, msg.topic):
-                    continue
+            replay: List[Tuple[int, Message]] = []
+            for sid, msg in self.load_unforwarded(stripped, id.client_id):
+                replay.append((sid, msg))
+                self.mark_forwarded(sid, id.client_id)
+            # merge_on_read: pull peers' unforwarded stored messages
+            # (cluster-raft/src/shared.rs:665-699 broadcast MessageGet)
+            cluster = getattr(self.ctx.registry, "cluster", None)
+            if self.merge_on_read and cluster is not None and cluster.peers:
+                from rmqtt_tpu.cluster import messages as M
+
+                replies = await cluster.bcast.join_all_call(
+                    M.MESSAGE_GET,
+                    {"filter": stripped, "client_id": id.client_id},
+                )
+                for _nid, reply in replies:
+                    if isinstance(reply, Exception):
+                        continue
+                    for sid, mw in reply.get("msgs", []):
+                        msg = msg_from_wire(mw)
+                        if not msg.is_expired():
+                            replay.append((sid, msg))
+            replay.sort(key=lambda it: it[1].create_time)
+            for _sid, msg in replay:
                 session.enqueue(
                     DeliverItem(msg=msg, qos=min(msg.qos, 1), retain=False,
                                 topic_filter=full_filter)
                 )
-                self.store.put(NS_FWD, fwd_key, True, ttl=self.default_expiry)
             return None
 
         self._unhooks = [
@@ -83,8 +156,11 @@ class MessageStoragePlugin(Plugin):
         for un in self._unhooks:
             un()
         self._unhooks = []
+        if getattr(self.ctx, "message_mgr", None) is self:
+            self.ctx.message_mgr = None
         self.store.close()
         return True
 
     def attrs(self):
-        return {"stored": self.store.count(NS_MSG)}
+        return {"stored": self.store.count(NS_MSG),
+                "merge_on_read": self.merge_on_read}

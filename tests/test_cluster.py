@@ -248,3 +248,99 @@ async def test_offline_inflight_and_grpc_hooks_fire(brokers, clusters):
     assert seen["offline_inflight"] == [["hk/off"]], seen["offline_inflight"]
     await sub.disconnect_clean()
     await pub.disconnect_clean()
+
+
+async def _with_storage(brokers, **cfg):
+    """Install a message-storage plugin on every node (returns for cleanup)."""
+    from rmqtt_tpu.plugins.message_storage import MessageStoragePlugin
+
+    plugins = []
+    for b in brokers:
+        p = MessageStoragePlugin(b.ctx, {"expiry": 60, **cfg})
+        await p.init()
+        plugins.append(p)
+    return plugins
+
+
+@cluster_test(2)
+async def test_merge_on_read_cross_node_replay(brokers, clusters):
+    """A message stored on node A reaches a subscriber that connects to
+    node B (merge_on_read, reference message.rs:73 +
+    cluster-raft/src/shared.rs:665-699 MessageGet broadcast)."""
+    b1, b2 = brokers
+    plugins = await _with_storage(brokers)
+    try:
+        pub = await TestClient.connect(b1.port, "mpub")
+        await pub.publish("store/t", b"offline-payload", qos=1)
+        await asyncio.sleep(0.1)
+        assert plugins[0].count() == 1  # stored on node 1 only
+        assert plugins[1].count() == 0
+        # subscriber appears on node 2: replay must merge from node 1
+        sub = await TestClient.connect(b2.port, "msub")
+        await sub.subscribe("store/#", qos=1)
+        p = await sub.recv()
+        assert p.topic == "store/t" and p.payload == b"offline-payload"
+        # re-subscribe: no double replay (marked forwarded on node 1)
+        await sub.subscribe("store/#", qos=1)
+        await asyncio.sleep(0.3)
+        assert sub.publishes.qsize() == 0
+    finally:
+        for p in plugins:
+            await p.stop()
+
+
+@cluster_test(2)
+async def test_forwards_to_ack_marks_forwarded(brokers, clusters):
+    """Cross-node live delivery acks back (ForwardsToAck,
+    cluster-raft/src/shared.rs:596-613): the publishing node's store marks
+    the recipient so a later subscribe-time replay can't repeat."""
+    b1, b2 = brokers
+    plugins = await _with_storage(brokers)
+    try:
+        sub = await TestClient.connect(b2.port, "acksub")
+        await sub.subscribe("ack/t", qos=1)
+        pub = await TestClient.connect(b1.port, "ackpub")
+        await pub.publish("ack/t", b"live", qos=1)
+        p = await sub.recv()
+        assert p.payload == b"live"
+        await asyncio.sleep(0.3)  # fire-and-forget ack lands on node 1
+        # node 1's store knows the delivery happened
+        assert plugins[0].load_unforwarded("ack/t", "acksub") == []
+        # re-subscribing on node 2 triggers MessageGet to node 1: no replay
+        await sub.subscribe("ack/t", qos=1)
+        await asyncio.sleep(0.3)
+        assert sub.publishes.qsize() == 0
+    finally:
+        for p in plugins:
+            await p.stop()
+
+
+@cluster_test(2)
+async def test_subscriptions_search_and_routes_get_by(brokers, clusters):
+    """SubscriptionsSearch + RoutesGetBy RPCs (grpc.rs:506-535) fan out and
+    filter across nodes."""
+    from rmqtt_tpu.cluster import messages as M
+
+    b1, b2 = brokers
+    c1 = await TestClient.connect(b1.port, "search-1")
+    await c1.subscribe("s/one", qos=1)
+    c2 = await TestClient.connect(b2.port, "search-2")
+    await c2.subscribe("s/+", qos=2)
+    # search by client id across the mesh (node 1 asks node 2)
+    reply = await clusters[0].peers[2].call(
+        M.SUBSCRIPTIONS_SEARCH, {"clientid": "search-2"}
+    )
+    rows = reply["subscriptions"]
+    assert rows == [{"client_id": "search-2", "node_id": 2,
+                     "topic_filter": "s/+", "qos": 2, "share": None}]
+    # qos filter excludes
+    reply = await clusters[0].peers[2].call(
+        M.SUBSCRIPTIONS_SEARCH, {"clientid": "search-2", "qos": 1}
+    )
+    assert reply["subscriptions"] == []
+    # RoutesGetBy: which filters on node 2 a publish to s/one would ride
+    reply = await clusters[0].peers[2].call(M.ROUTES_GET_BY, {"topic": "s/one"})
+    assert reply["routes"] == [{"topic": "s/+", "node_id": 2}]
+    # ROUTES_GET lists node-local route edges
+    reply = await clusters[0].peers[2].call(M.ROUTES_GET, {"limit": 10})
+    assert any(r.get("topic_filter", r.get("topic")) == "s/+" for r in reply["routes"])
