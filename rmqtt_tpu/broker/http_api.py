@@ -198,10 +198,11 @@ class HttpApi:
 
     async def _route(self, method: str, target: str, body: bytes) -> Tuple[int, Any, str]:
         url = urlparse(target)
-        path = unquote(url.path).rstrip("/")
+        raw_path = unquote(url.path)
+        path = raw_path.rstrip("/")
         q = parse_qs(url.query)
         try:
-            return await self._dispatch(method, path, q, body)
+            return await self._dispatch(method, path, q, body, raw_path)
         except (KeyError, ValueError, TypeError) as e:
             return 400, {"error": f"bad request: {e}"}, "application/json"
         except Exception as e:
@@ -209,7 +210,8 @@ class HttpApi:
             return 500, {"error": str(e)}, "application/json"
 
     # ------------------------------------------------------------ endpoints
-    async def _dispatch(self, method: str, path: str, q, body: bytes) -> Tuple[int, Any, str]:
+    async def _dispatch(self, method: str, path: str, q, body: bytes,
+                        raw_path: str = "") -> Tuple[int, Any, str]:
         ctx = self.ctx
         J = "application/json"
         if path in ("", "/index.html", "/dashboard"):  # note: "/" rstrips to ""
@@ -265,8 +267,10 @@ class HttpApi:
             )
             return 200, rows[: limit], J
         if path.startswith("/api/v1/routes/"):
-            # routes a publish to this topic would take (api.rs routes/{topic})
-            topic = path[len("/api/v1/routes/"):]
+            # routes a publish to this topic would take (api.rs routes/{topic});
+            # use the un-rstripped path — trailing slashes are distinct
+            # (empty) MQTT topic levels
+            topic = (raw_path or path)[len("/api/v1/routes/"):]
             rows = routes_by_topic(ctx, topic)
             rows += await _cluster_merge(
                 ctx, M.ROUTES_GET_BY, {"topic": topic},

@@ -498,11 +498,15 @@ async def _amain(args) -> None:
             cluster = RaftCluster(
                 broker.ctx, settings.cluster_listen, settings.peers,
                 raft_db=settings.raft_db,
+                retain_sync_mode=settings.retain_sync_mode,
             )
         else:
             from rmqtt_tpu.cluster.broadcast import BroadcastCluster
 
-            cluster = BroadcastCluster(broker.ctx, settings.cluster_listen, settings.peers)
+            cluster = BroadcastCluster(
+                broker.ctx, settings.cluster_listen, settings.peers,
+                retain_sync_mode=settings.retain_sync_mode,
+            )
         await cluster.start()
     api = None
     if settings.http_api and not getattr(args, "no_http_api", False):

@@ -782,7 +782,7 @@ class SessionState:
         return False
 
     async def _send_retained(self, topic_filter: str, sopts: SubscriptionOptions) -> None:
-        for _topic, msg in self.ctx.retain.matches(topic_filter):
+        for _topic, msg in await self.ctx.registry.retain_load_with(topic_filter):
             item = DeliverItem(
                 msg=msg,
                 qos=min(sopts.qos, msg.qos),

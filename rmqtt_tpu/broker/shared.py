@@ -148,6 +148,12 @@ class SessionRegistry:
         await self.router_remove(stripped, session.id)
         return True
 
+    async def retain_load_with(self, topic_filter: str):
+        """Retained messages matching a new subscription (the reference's
+        ``retain_load_with``, shared.rs:290-295): node-local here; cluster
+        registries merge peers' stores under TopicOnly sync."""
+        return self.ctx.retain.matches(topic_filter)
+
     # --------------------------------------------------------------- fanout
     async def forwards(self, msg: Message) -> int:
         """Route + deliver; returns the number of target subscribers
@@ -160,6 +166,7 @@ class SessionRegistry:
             target.enqueue(
                 DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter="")
             )
+            self._mark_forwarded(msg, msg.target_clientid)
             return 1
         relmap = await self.ctx.routing.matches(msg.from_id, msg.topic)
         count = 0
@@ -186,10 +193,20 @@ class SessionRegistry:
                 sub_ids=opts.subscription_ids,
             )
         )
-        # live delivery counts as forwarded for the message store, so a
-        # later subscribe-time replay skips it (shared.rs:751-760)
-        if msg.stored_id is not None:
-            mgr = getattr(self.ctx, "message_mgr", None)
-            if mgr is not None:
-                mgr.mark_forwarded(msg.stored_id, client_id)
+        self._mark_forwarded(msg, client_id)
         return 1
+
+    def _mark_forwarded(self, msg: Message, client_id: str) -> None:
+        """Live delivery counts as forwarded for the message store, so a
+        later subscribe-time replay skips it (shared.rs:751-760). Only the
+        node that stored the message (its publish-ingress node, from_id)
+        marks — a foreign stored_id written into THIS node's store could
+        collide with a local sid and suppress a legitimate replay; remote
+        deliveries are reconciled by ForwardsToAck instead."""
+        if msg.stored_id is None or (
+            msg.from_id is not None and msg.from_id.node_id != self.ctx.node_id
+        ):
+            return
+        mgr = getattr(self.ctx, "message_mgr", None)
+        if mgr is not None:
+            mgr.mark_forwarded(msg.stored_id, client_id, ttl=msg.expiry_interval)

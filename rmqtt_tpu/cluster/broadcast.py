@@ -36,8 +36,16 @@ log = logging.getLogger("rmqtt_tpu.cluster")
 _UNHANDLED = object()
 
 
+def _spawn(cluster, coro) -> None:
+    """Strong-ref'd fire-and-forget task (asyncio holds tasks weakly — an
+    unreferenced task could be GC'd before it runs)."""
+    task = asyncio.get_running_loop().create_task(coro)
+    cluster._bg_tasks.add(task)
+    task.add_done_callback(cluster._bg_tasks.discard)
+
+
 def _bg_notify(cluster, peer, mtype: str, body) -> None:
-    """Fire-and-forget peer notify from a handler (strong-ref'd task)."""
+    """Fire-and-forget peer notify from a handler."""
 
     async def push():
         try:
@@ -45,9 +53,7 @@ def _bg_notify(cluster, peer, mtype: str, body) -> None:
         except PeerUnavailable:
             log.warning("%s to node %s failed", mtype, peer.node_id)
 
-    task = asyncio.get_running_loop().create_task(push())
-    cluster._bg_tasks.add(task)
-    task.add_done_callback(cluster._bg_tasks.discard)
+    _spawn(cluster, push())
 
 
 async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=None) -> object:
@@ -56,19 +62,20 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
     mode-specific types."""
     if mtype == M.FORWARDS_TO:
         msg = M.msg_from_wire(body["msg"])
+        count = 0
+        recipients: List[str] = []
         if body.get("p2p"):
             target = ctx.registry.get(body["p2p"])
             if target is None:
                 raise ClusterReplyError("no-such-client")  # select_ok tries next peer
             target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
-            return {"count": 1}
-        count = 0
-        recipients: List[str] = []
-        for rw in body["rels"]:
-            rel = M.relation_from_wire(rw)
-            if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
-                count += 1
-                recipients.append(rel.id.client_id)
+            count, recipients = 1, [body["p2p"]]
+        else:
+            for rw in body["rels"]:
+                rel = M.relation_from_wire(rw)
+                if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
+                    count += 1
+                    recipients.append(rel.id.client_id)
         # fire-and-forget mark-forwarded ack back to the publishing node
         # (cluster-raft/src/shared.rs:596-613 ForwardsToAck); the sender's
         # node id rides in the body (the transport has no peer identity)
@@ -77,13 +84,14 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             peer = cluster.peers.get(sender)
             if peer is not None:
                 _bg_notify(cluster, peer, M.FORWARDS_TO_ACK,
-                           {"sid": msg.stored_id, "recipients": recipients})
+                           {"sid": msg.stored_id, "recipients": recipients,
+                            "ttl": msg.expiry_interval})
         return {"count": count}
     if mtype == M.FORWARDS_TO_ACK:
         mgr = getattr(ctx, "message_mgr", None)
         if mgr is not None:
             for cid in body.get("recipients", []):
-                mgr.mark_forwarded(body["sid"], cid)
+                mgr.mark_forwarded(body["sid"], cid, ttl=body.get("ttl"))
         return None
     if mtype == M.MESSAGE_GET:
         # merge_on_read fetch (cluster-raft/src/shared.rs:665-699): return
@@ -118,8 +126,15 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             return {"kicked": True, "state": state}
         return {"kicked": False}
     if mtype == M.GET_RETAINS:
+        # "match" requests MQTT wildcard semantics ($-topics excluded from
+        # wildcards, topic.rs:185-210) — the subscribe-time TopicOnly fetch;
+        # the bare "#" form is the full-store replication pull (startup
+        # sync), which must include $-topics
         filt = body.get("filter", "#")
-        items = ctx.retain.all_items() if filt == "#" else ctx.retain.matches(filt)
+        if body.get("match"):
+            items = ctx.retain.matches(filt)
+        else:
+            items = ctx.retain.all_items() if filt == "#" else ctx.retain.matches(filt)
         return {"retains": [[topic, M.msg_to_wire(m)] for topic, m in items]}
     if mtype == M.SET_RETAIN:
         mw = body.get("msg")
@@ -186,6 +201,31 @@ class ClusterRegistryBase(SessionRegistry):
             await self._restore_transferred(ctx, id, clean_start, replies)
         return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
 
+    async def retain_load_with(self, topic_filter: str):
+        """TopicOnly retain sync (reference retain.rs:162 `retain_sync_mode`
+        + :178 `sync_retain_topic`): with no full-store replication, fetch
+        the peers' retained matches for exactly this filter at subscribe
+        time and dedup by topic keeping the newest create_time
+        (shared.rs:1109-1127 dedup_retains_by_topic)."""
+        local = self.ctx.retain.matches(topic_filter)
+        c = self.cluster
+        if c is None or not c.peers or c.retain_sync_mode != "topic_only":
+            return local
+        best = {topic: msg for topic, msg in local}
+        for _nid, reply in await c.bcast.join_all_call(
+            M.GET_RETAINS, {"filter": topic_filter, "match": True}
+        ):
+            if isinstance(reply, Exception):
+                continue
+            for topic, mw in reply.get("retains", []):
+                msg = M.msg_from_wire(mw)
+                if msg.is_expired():
+                    continue
+                cur = best.get(topic)
+                if cur is None or msg.create_time > cur.create_time:
+                    best[topic] = msg
+        return sorted(best.items())
+
     async def _restore_transferred(self, ctx, id, clean_start: bool, replies) -> None:
         if clean_start or ctx.registry.get(id.client_id) is not None:
             return
@@ -232,6 +272,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     "msg": M.msg_to_wire(msg),
                     "rels": [],
                     "p2p": msg.target_clientid,
+                    "from_node": self.ctx.node_id,
                 })
                 return 1
             except (PeerUnavailable, ClusterReplyError):
@@ -254,7 +295,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
             # (the broadcast-mode analogue of ForwardsToAck bookkeeping)
             if mgr is not None and msg.stored_id is not None:
                 for cid in reply.get("recipients", []):
-                    mgr.mark_forwarded(msg.stored_id, cid)
+                    mgr.mark_forwarded(msg.stored_id, cid, ttl=msg.expiry_interval)
             for key, cands in _cands_from_wire(reply.get("shared", [])).items():
                 merged.setdefault(key, []).extend(cands)
         # 3) global shared-group choice (src/shared.rs:516-560)
@@ -303,6 +344,7 @@ class BroadcastCluster:
         listen: Tuple[str, int],
         peers: List[Tuple[int, str, int]],
         sync_retains: bool = True,
+        retain_sync_mode: str = "full",
     ) -> None:
         self.ctx = ctx
         self.server = ClusterServer(listen[0], listen[1], self._on_message)
@@ -310,7 +352,11 @@ class BroadcastCluster:
             nid: PeerClient(nid, host, port) for nid, host, port in peers
         }
         self.bcast = Broadcaster(list(self.peers.values()))
-        self.sync_retains = sync_retains
+        # "full": replicate every retain set + startup pull; "topic_only":
+        # no replication, lazy per-filter fetch at subscribe time
+        # (retain.rs:162 RetainSyncMode Full vs TopicOnly)
+        self.retain_sync_mode = retain_sync_mode
+        self.sync_retains = sync_retains and retain_sync_mode == "full"
         assert isinstance(ctx.registry, ClusterSessionRegistry), (
             "cluster mode needs ServerContext(registry='cluster')"
         )
@@ -346,15 +392,15 @@ class BroadcastCluster:
 
     # ----------------------------------------------------------- outbound
     def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
+        if self.retain_sync_mode != "full":
+            return  # TopicOnly: peers fetch lazily at subscribe time
         async def push():
             await self.bcast.join_all_notify(
                 M.SET_RETAIN,
                 {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
             )
 
-        task = asyncio.get_running_loop().create_task(push())
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        _spawn(self, push())
 
     # ------------------------------------------------------------ inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:

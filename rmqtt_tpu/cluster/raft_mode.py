@@ -22,6 +22,7 @@ from rmqtt_tpu.broker.types import HandshakeLockedError, Message
 from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.broadcast import (
     _UNHANDLED,
+    _spawn,
     ClusterRegistryBase,
     handle_common_message,
 )
@@ -82,22 +83,16 @@ class RaftSessionRegistry(ClusterRegistryBase):
         if not ok:
             # the entry may still commit later (it stays in the log);
             # compensate so a late commit can't leave a ghost route
-            task = asyncio.get_running_loop().create_task(
-                c.raft.propose({"op": "remove", "tf": stripped,
-                                "node": id.node_id, "client": id.client_id},
-                               timeout=30.0)
-            )
-            c._bg_tasks.add(task)
-            task.add_done_callback(c._bg_tasks.discard)
+            _spawn(c, c.raft.propose({"op": "remove", "tf": stripped,
+                                      "node": id.node_id, "client": id.client_id},
+                                     timeout=30.0))
             raise ClusterReplyError("raft propose (add) failed")
 
     def _retry_in_background(self, entry) -> None:
         """Removals must eventually apply — retry with a long deadline when
         consensus is briefly unavailable (no leader / partition)."""
         c = self.cluster
-        task = asyncio.get_running_loop().create_task(c.raft.propose(entry, timeout=120.0))
-        c._bg_tasks.add(task)
-        task.add_done_callback(c._bg_tasks.discard)
+        _spawn(c, c.raft.propose(entry, timeout=120.0))
 
     async def router_remove(self, stripped: str, id) -> None:
         c = self.cluster
@@ -132,6 +127,7 @@ class RaftSessionRegistry(ClusterRegistryBase):
             try:
                 await c.bcast.select_ok(M.FORWARDS_TO, {
                     "msg": M.msg_to_wire(msg), "rels": [], "p2p": msg.target_clientid,
+                    "from_node": self.ctx.node_id,
                 })
                 return 1
             except (PeerUnavailable, ClusterReplyError):
@@ -192,6 +188,7 @@ class RaftCluster:
         peers: List[Tuple[int, str, int]],
         sync_retains: bool = True,
         raft_db: Optional[str] = None,
+        retain_sync_mode: str = "full",
     ) -> None:
         self.ctx = ctx
         self.server = ClusterServer(listen[0], listen[1], self._on_message)
@@ -199,7 +196,10 @@ class RaftCluster:
             nid: PeerClient(nid, host, port) for nid, host, port in peers
         }
         self.bcast = Broadcaster(list(self.peers.values()))
-        self.sync_retains = sync_retains
+        # retain.rs:162 RetainSyncMode: Full replicates; TopicOnly fetches
+        # per-filter at subscribe time (see ClusterRegistryBase.retain_load_with)
+        self.retain_sync_mode = retain_sync_mode
+        self.sync_retains = sync_retains and retain_sync_mode == "full"
         storage = None
         if raft_db:
             from rmqtt_tpu.storage.sqlite import SqliteStore
@@ -352,22 +352,18 @@ class RaftCluster:
             "op": "hs_unlock", "client": client_id,
             "node": self.ctx.node_id, "nonce": nonce,
         }
-        task = asyncio.get_running_loop().create_task(
-            self.raft.propose(entry, timeout=30.0)
-        )
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        _spawn(self, self.raft.propose(entry, timeout=30.0))
 
     def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
+        if self.retain_sync_mode != "full":
+            return  # TopicOnly: peers fetch lazily at subscribe time
         async def push():
             await self.bcast.join_all_notify(
                 M.SET_RETAIN,
                 {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
             )
 
-        task = asyncio.get_running_loop().create_task(push())
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        _spawn(self, push())
 
     # -------------------------------------------------------------- inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:

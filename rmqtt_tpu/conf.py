@@ -76,6 +76,7 @@ class Settings:
     http_api: Optional[Dict[str, Any]]  # {"host":..., "port":...} or None
     cluster_listen: Optional[Tuple[str, int]]
     raft_db: Optional[str]
+    retain_sync_mode: str  # "full" | "topic_only" (retain.rs:162)
     peers: List[Tuple[int, str, int]]
     plugins: Dict[str, Dict[str, Any]]  # name → config
     default_startups: List[str]
@@ -128,6 +129,12 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
 
     cluster_listen = None
     raft_db = None
+    retain_sync_mode = str(cluster.get("retain_sync_mode", "full"))
+    if retain_sync_mode not in ("full", "topic_only"):
+        raise ValueError(
+            f"cluster.retain_sync_mode must be 'full' or 'topic_only', "
+            f"got {retain_sync_mode!r}"
+        )
     peers: List[Tuple[int, str, int]] = []
     if cluster.get("listen"):
         host, _, port = str(cluster["listen"]).rpartition(":")
@@ -155,6 +162,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         http_api=http_api,
         cluster_listen=cluster_listen,
         raft_db=raft_db,
+        retain_sync_mode=retain_sync_mode,
         peers=peers,
         plugins=plugin_cfgs,
         default_startups=default_startups,

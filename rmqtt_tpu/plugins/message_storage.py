@@ -47,8 +47,11 @@ class MessageStoragePlugin(Plugin):
         # merge_on_read (message.rs:73): pull stored messages from peers at
         # subscribe time instead of replicating the store
         self.merge_on_read = bool(self.config.get("merge_on_read", True))
+        # node-namespaced sids (node id in the high bits): two nodes can
+        # never allocate the same stored id, so a ForwardsToAck arriving at
+        # the wrong store could not collide with a local message's id
         self._msg_id = itertools.count(
-            int(time.time() * 1000) * 1000 + (ctx.node_id % 1000)
+            (ctx.node_id << 48) + (int(time.time() * 1000) & ((1 << 48) - 1))
         )
         self._unhooks = []
 
@@ -63,12 +66,16 @@ class MessageStoragePlugin(Plugin):
         self.ctx.metrics.inc("storage.messages_stored")
         return sid
 
-    def mark_forwarded(self, stored_id: int, client_id: str) -> None:
+    def mark_forwarded(self, stored_id: int, client_id: str,
+                       ttl: Optional[float] = None) -> None:
         """Record delivery so subscribe-time replay skips it
         (message.rs `mark_forwarded`; called from the live fan-out like
-        shared.rs:751-760, and from cross-node ForwardsToAck)."""
+        shared.rs:751-760, and from cross-node ForwardsToAck). The marker
+        must outlive the message it guards, so its TTL is at least the
+        message's own expiry when the caller knows it."""
         self.store.put(
-            NS_FWD, f"{stored_id}\x00{client_id}", True, ttl=self.default_expiry
+            NS_FWD, f"{stored_id}\x00{client_id}", True,
+            ttl=max(self.default_expiry, ttl or 0.0),
         )
 
     def load_unforwarded(
@@ -80,14 +87,16 @@ class MessageStoragePlugin(Plugin):
         handler uses this so a remote replay can't repeat."""
         out: List[Tuple[int, Message]] = []
         for msg_id, mw in self.store.scan(NS_MSG):
-            if self.store.get(NS_FWD, f"{msg_id}\x00{client_id}") is not None:
-                continue
             msg = msg_from_wire(mw)
+            # cheap in-memory checks first; the forwarded lookup is a store
+            # round-trip and most stored messages won't match the filter
             if msg.is_expired() or not match_filter(stripped_filter, msg.topic):
+                continue
+            if self.store.get(NS_FWD, f"{msg_id}\x00{client_id}") is not None:
                 continue
             out.append((int(msg_id), msg))
             if mark:
-                self.mark_forwarded(int(msg_id), client_id)
+                self.mark_forwarded(int(msg_id), client_id, ttl=msg.expiry_interval)
         return out
 
     def count(self) -> int:
@@ -121,7 +130,7 @@ class MessageStoragePlugin(Plugin):
             replay: List[Tuple[int, Message]] = []
             for sid, msg in self.load_unforwarded(stripped, id.client_id):
                 replay.append((sid, msg))
-                self.mark_forwarded(sid, id.client_id)
+                self.mark_forwarded(sid, id.client_id, ttl=msg.expiry_interval)
             # merge_on_read: pull peers' unforwarded stored messages
             # (cluster-raft/src/shared.rs:665-699 broadcast MessageGet)
             cluster = getattr(self.ctx.registry, "cluster", None)

@@ -344,3 +344,57 @@ async def test_subscriptions_search_and_routes_get_by(brokers, clusters):
     # ROUTES_GET lists node-local route edges
     reply = await clusters[0].peers[2].call(M.ROUTES_GET, {"limit": 10})
     assert any(r.get("topic_filter", r.get("topic")) == "s/+" for r in reply["routes"])
+
+
+def test_topic_only_retain_sync():
+    """retain_sync_mode=topic_only (reference retain.rs:162,178): retains are
+    NOT replicated; a subscriber's node fetches matches for exactly its
+    filter from peers at subscribe time, newest create_time winning the
+    per-topic dedup (shared.rs:1109-1127)."""
+
+    async def run():
+        brokers = [await make_node(i + 1) for i in range(2)]
+        clusters = []
+        for b in brokers:
+            c = BroadcastCluster(b.ctx, ("127.0.0.1", 0), [],
+                                 retain_sync_mode="topic_only")
+            await c.start()
+            clusters.append(c)
+        from rmqtt_tpu.cluster.transport import PeerClient
+
+        for i, c in enumerate(clusters):
+            for j, other in enumerate(clusters):
+                if i != j:
+                    nid = brokers[j].ctx.node_id
+                    c.peers[nid] = PeerClient(nid, "127.0.0.1", other.bound_port)
+            c.bcast.peers = list(c.peers.values())
+        b1, b2 = brokers
+        try:
+            pub = await TestClient.connect(b1.port, "topub")
+            await pub.publish("lazy/t", b"v-old", retain=True, qos=1)
+            await asyncio.sleep(0.3)
+            # NOT replicated: node 2's store is empty
+            assert b2.ctx.retain.get("lazy/t") is None
+            # but a subscriber on node 2 still gets it (lazy per-filter fetch)
+            sub = await TestClient.connect(b2.port, "topicsub")
+            await sub.subscribe("lazy/#", qos=1)
+            p = await asyncio.wait_for(sub.recv(), 5.0)
+            assert p.payload == b"v-old" and p.retain
+            # newest-wins dedup: node 2 now retains a NEWER copy locally;
+            # a fresh subscriber must see exactly one message, the newer one
+            await asyncio.sleep(0.05)
+            pub2 = await TestClient.connect(b2.port, "topub2")
+            await pub2.publish("lazy/t", b"v-new", retain=True, qos=1)
+            sub2 = await TestClient.connect(b2.port, "topicsub2")
+            await sub2.subscribe("lazy/#", qos=1)
+            p2 = await asyncio.wait_for(sub2.recv(), 5.0)
+            assert p2.payload == b"v-new"
+            await asyncio.sleep(0.3)
+            assert sub2.publishes.qsize() == 0  # deduped: one delivery only
+        finally:
+            for c in clusters:
+                await c.stop()
+            for b in brokers:
+                await b.stop()
+
+    asyncio.run(run())
