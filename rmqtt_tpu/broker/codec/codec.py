@@ -166,7 +166,8 @@ class MqttCodec:
                     return
             if err:
                 self.pending_error = ProtocolError(
-                    _SCAN_ERRORS.get(err, f"scan error {err}")
+                    _SCAN_ERRORS.get(err, f"scan error {err}"),
+                    reason_code=0x95 if err == 2 else 0x81,
                 )
                 return
             if not hit_cap:
@@ -211,7 +212,10 @@ class MqttCodec:
             if mult > 128**3:
                 raise ProtocolError("malformed remaining length")
         if length > self.max_inbound_size:
-            raise ProtocolError(f"packet too large: {length} > {self.max_inbound_size}")
+            raise ProtocolError(
+                f"packet too large: {length} > {self.max_inbound_size}",
+                reason_code=0x95,
+            )
         if len(buf) < i + length:
             return None
         first = buf[0]

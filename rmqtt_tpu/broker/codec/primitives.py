@@ -4,7 +4,13 @@ from __future__ import annotations
 
 
 class ProtocolViolation(ValueError):
-    pass
+    """Wire-level violation. ``reason_code`` is the v5 DISCONNECT reason the
+    server should send before closing (0x81 malformed packet by default;
+    the codec's size cap uses 0x95 packet-too-large)."""
+
+    def __init__(self, msg: str, reason_code: int = 0x81) -> None:
+        super().__init__(msg)
+        self.reason_code = reason_code
 
 
 def encode_varint(n: int) -> bytes:

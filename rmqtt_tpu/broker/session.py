@@ -372,10 +372,12 @@ class SessionState:
         early, self.early_packets = self.early_packets, []
         for p in early:
             await self._handle(p)
-        if early and self.codec.pending_error is not None:
-            # the pipelined CONNECT burst ended in a malformed frame: the
-            # valid packets above were processed first, then close
+        if self.codec.pending_error is not None:
+            # the pipelined CONNECT burst ended in a malformed frame (even
+            # with no valid packets between CONNECT and the bad frame):
+            # any valid packets above were processed first, then close
             self.ctx.metrics.inc("protocol.errors")
+            await self._disconnect_with(self.codec.pending_error.reason_code)
             return
         while True:
             data = await self.reader.read(65536)
@@ -384,8 +386,11 @@ class SessionState:
             self._last_packet = time.monotonic()
             try:
                 packets = self.codec.feed(data)
-            except ProtocolViolation:
+            except ProtocolViolation as e:
                 self.ctx.metrics.inc("protocol.errors")
+                # v5: name the violation before closing (DISCONNECT 0x95
+                # packet-too-large / 0x81 malformed; disconnect.rs reasons)
+                await self._disconnect_with(e.reason_code)
                 return
             for p in packets:
                 await self._handle(p)
@@ -393,6 +398,7 @@ class SessionState:
                 # a later frame in the chunk was malformed; valid packets
                 # above were processed first
                 self.ctx.metrics.inc("protocol.errors")
+                await self._disconnect_with(self.codec.pending_error.reason_code)
                 return
 
     async def _deliver_loop(self) -> None:
