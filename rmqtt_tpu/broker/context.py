@@ -31,6 +31,9 @@ class BrokerConfig:
     ws_port: Optional[int] = None
     tls_port: Optional[int] = None
     wss_port: Optional[int] = None
+    # MQTT over QUIC (rmqtt-net/src/quic.rs): served iff a QuicBackend is
+    # registered (broker/quic.py); fails fast at startup otherwise
+    quic_port: Optional[int] = None
     tls_cert: str = ""
     tls_key: str = ""
     # require + verify client certificates against this CA bundle; the cert's

@@ -78,6 +78,7 @@ class MqttBroker:
         self._ws_server: Optional[asyncio.base_events.Server] = None
         self._tls_server: Optional[asyncio.base_events.Server] = None
         self._wss_server: Optional[asyncio.base_events.Server] = None
+        self._quic_server = None  # QuicServerHandle (broker/quic.py)
 
     def _bound(self, srv) -> int:
         return srv.sockets[0].getsockname()[1]
@@ -138,6 +139,17 @@ class MqttBroker:
                 self._on_ws_connection, cfg.host, cfg.wss_port, ssl=sslctx, **rp
             )
             log.info("wss listening on %s:%s", cfg.host, self.wss_port)
+        if cfg.quic_port is not None:
+            # MQTT over one bidi QUIC stream (server.rs listen_quic path);
+            # raises QuicUnavailableError when no stack is registered
+            from rmqtt_tpu.broker.quic import get_backend
+
+            self._quic_server = await get_backend().serve(
+                cfg.host, cfg.quic_port, self._on_connection,
+                cfg.tls_cert, cfg.tls_key,
+            )
+            log.info("quic listening on %s:%s", cfg.host,
+                     self._quic_server.bound_port)
 
     async def stop(self) -> None:
         # close sessions BEFORE wait_closed(): in py3.12 Server.wait_closed
@@ -150,6 +162,8 @@ class MqttBroker:
             if srv is not None:
                 srv.close()
                 await srv.wait_closed()
+        if self._quic_server is not None:
+            await self._quic_server.close()
         await self.ctx.plugins.stop_all()
         await self.ctx.stop()
 

@@ -107,6 +107,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "port": int(listener.get("port", 1883)),
         "ws_port": int(listener["ws_port"]) if "ws_port" in listener else None,
         "tls_port": int(listener["tls_port"]) if "tls_port" in listener else None,
+        "quic_port": int(listener["quic_port"]) if "quic_port" in listener else None,
         "wss_port": int(listener["wss_port"]) if "wss_port" in listener else None,
         "tls_cert": listener.get("tls_cert", ""),
         "tls_key": listener.get("tls_key", ""),

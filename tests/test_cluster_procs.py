@@ -147,3 +147,372 @@ def test_three_process_cluster_with_chaos():
         # broker processes must exit cleanly on SIGTERM (no tracebacks)
         for i, tail in errs.items():
             assert "Traceback" not in tail, f"node {i} stderr:\n{tail}"
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection on the cluster transport (the reference's harness injector,
+# rmqtt-test/src/chaos.rs + tests/chaos/{packet_loss,restart}.rs): every
+# node-to-node link runs through a per-(src,dst) TCP proxy owned by the test,
+# which can partition (refuse + kill live conns), blackhole (accept, never
+# forward) or go flaky (abort each connection after N forwarded bytes — the
+# TCP manifestation of packet loss: stalls and resets forcing reconnects).
+
+
+class LinkProxy:
+    """One direction of one cluster link (src → dst)."""
+
+    def __init__(self, target_port: int) -> None:
+        self.target_port = target_port
+        self.mode = "pass"  # pass | drop | blackhole
+        self.flaky_bytes = None  # abort each conn after this many bytes
+        self._conns: set = set()
+        self._server = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        self._kill_conns()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def set_mode(self, mode: str, flaky_bytes=None) -> None:
+        self.mode = mode
+        self.flaky_bytes = flaky_bytes
+        self._kill_conns()  # chaos applies to live connections too
+
+    def _kill_conns(self) -> None:
+        for w in list(self._conns):
+            try:
+                w.transport.abort()
+            except Exception:
+                pass
+        self._conns.clear()
+
+    async def _on_conn(self, reader, writer) -> None:
+        self._conns.add(writer)
+        try:
+            if self.mode == "drop":
+                return
+            if self.mode == "blackhole":
+                while await reader.read(65536):
+                    pass  # swallow silently; sender sees a stall, not a reset
+                return
+            try:
+                up_r, up_w = await asyncio.open_connection(
+                    "127.0.0.1", self.target_port
+                )
+            except OSError:
+                return
+            self._conns.add(up_w)
+            budget = [self.flaky_bytes] if self.flaky_bytes else None
+
+            async def pump(r, w):
+                try:
+                    while True:
+                        data = await r.read(65536)
+                        if not data:
+                            # propagate the clean one-sided close a real
+                            # TCP link would show the other end
+                            try:
+                                w.write_eof()
+                            except (OSError, RuntimeError):
+                                pass
+                            break
+                        if budget is not None:
+                            budget[0] -= len(data)
+                            if budget[0] <= 0:
+                                w.transport.abort()
+                                break
+                        w.write(data)
+                        await w.drain()
+                except (ConnectionError, OSError):
+                    pass
+
+            try:
+                await asyncio.gather(
+                    pump(reader, up_w), pump(up_r, writer), return_exceptions=True
+                )
+            finally:
+                self._conns.discard(up_w)
+                try:
+                    up_w.close()
+                except Exception:
+                    pass
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+class ChaosCluster:
+    """3 broker processes fully meshed through LinkProxies."""
+
+    def __init__(self) -> None:
+        self.mports = _free_ports(3)
+        self.cports = _free_ports(3)
+        self.procs: dict = {}
+        self.proxies: dict = {}  # (src, dst) -> LinkProxy
+
+    async def start(self) -> None:
+        pport = self.pport = {}
+        for i in (1, 2, 3):
+            for j in (1, 2, 3):
+                if i != j:
+                    proxy = LinkProxy(self.cports[j - 1])
+                    self.proxies[(i, j)] = proxy
+                    pport[(i, j)] = await proxy.start()
+        for i in (1, 2, 3):
+            peers = [(j, pport[(i, j)]) for j in (1, 2, 3) if j != i]
+            self.procs[i] = _spawn_node(
+                i, self.mports[i - 1], self.cports[i - 1], peers
+            )
+        for p in self.mports:
+            await asyncio.get_running_loop().run_in_executor(None, _wait_port, p)
+
+    def partition(self, node: int) -> None:
+        """Cut every link to and from ``node`` (symmetric partition)."""
+        for (i, j), proxy in self.proxies.items():
+            if node in (i, j):
+                proxy.set_mode("drop")
+
+    def heal(self, node: int) -> None:
+        for (i, j), proxy in self.proxies.items():
+            if node in (i, j):
+                proxy.set_mode("pass")
+
+    def flaky_all(self, nbytes: int) -> None:
+        for proxy in self.proxies.values():
+            proxy.set_mode("pass", flaky_bytes=nbytes)
+
+    def steady_all(self) -> None:
+        for proxy in self.proxies.values():
+            proxy.set_mode("pass")
+
+    async def leader_of(self, node: int):
+        """Ask ``node`` who it thinks leads (cluster PING reply)."""
+        from rmqtt_tpu.cluster import messages as M
+        from rmqtt_tpu.cluster.transport import PeerClient
+
+        peer = PeerClient(node, "127.0.0.1", self.cports[node - 1])
+        try:
+            reply = await peer.call(M.PING, {}, timeout=2.0)
+            return reply.get("leader")
+        finally:
+            await peer.close()
+
+    async def wait_leader(self, via: int, timeout: float = 15.0,
+                          exclude=None) -> int:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            try:
+                lid = await self.leader_of(via)
+            except Exception:
+                lid = None
+            if lid and lid != exclude:
+                return lid
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"no leader (via node {via}, exclude={exclude})")
+
+    async def stop(self) -> dict:
+        errs = {}
+        for i, proc in self.procs.items():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for i, proc in self.procs.items():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            if proc.stderr is not None:
+                tail = proc.stderr.read()[-2000:]
+                if tail and "Traceback" in tail:
+                    errs[i] = tail
+        for proxy in self.proxies.values():
+            await proxy.stop()
+        return errs
+
+
+def _chaos_test(fn):
+    def wrapper():
+        async def run():
+            cc = ChaosCluster()
+            await cc.start()
+            errs = {}
+            try:
+                await asyncio.wait_for(fn(cc), timeout=180.0)
+            finally:
+                errs = await cc.stop()
+            assert not errs, f"node stderr tracebacks: {errs}"
+
+        asyncio.run(run())
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+async def _publish_stream(client, topic: str, stop_evt, acked: list,
+                          prefix: str = "seq"):
+    """QoS1 publisher: payloads it got a PUBACK for are recorded — the
+    at-least-once delivery invariant is checked against this set. A
+    distinct ``prefix`` per phase keeps phases' payload namespaces
+    disjoint (a late phase-1 arrival must not satisfy a phase-2 check)."""
+    seq = 0
+    while not stop_evt.is_set():
+        payload = f"{prefix}-{seq}".encode()
+        try:
+            await client.publish(topic, payload, qos=1)
+            acked.append(payload)
+        except (ConnectionError, asyncio.TimeoutError):
+            await asyncio.sleep(0.1)
+        seq += 1
+        await asyncio.sleep(0.02)
+
+
+async def _drain_until(sub, want: set, timeout: float) -> set:
+    got = set()
+    deadline = asyncio.get_running_loop().time() + timeout
+    while got < want and asyncio.get_running_loop().time() < deadline:
+        try:
+            p = await sub.recv(timeout=1.0)
+            got.add(p.payload)
+        except asyncio.TimeoutError:
+            pass
+    return got
+
+
+@_chaos_test
+async def test_chaos_partition_leader_mid_publish(cc):
+    """Partition the raft LEADER while a publisher streams QoS1: the
+    majority elects a new leader, routing continues, new subscriptions
+    commit, and every acked message is delivered; the healed ex-leader
+    rejoins the same term order (chaos.rs partition scenario)."""
+    leader = await cc.wait_leader(via=1)
+    others = [n for n in (1, 2, 3) if n != leader]
+    sub = await TestClient.connect(cc.mports[others[0] - 1], "pl-sub")
+    for attempt in range(60):
+        ack = await sub.subscribe("pl/t", qos=1)
+        if ack.reason_codes[0] < 0x80:
+            break
+        await asyncio.sleep(0.5)
+    else:
+        raise AssertionError("pl-sub subscription never committed")
+    pub = await TestClient.connect(cc.mports[others[1] - 1], "pl-pub")
+    stop_evt, acked = asyncio.Event(), []
+    stream = asyncio.create_task(_publish_stream(pub, "pl/t", stop_evt, acked))
+    await asyncio.sleep(1.0)  # traffic flowing
+    cc.partition(leader)
+    # the majority side elects a replacement leader
+    new_leader = await cc.wait_leader(via=others[0], exclude=leader)
+    assert new_leader != leader
+    # consensus works on the majority: a NEW subscription commits
+    sub2 = await TestClient.connect(cc.mports[others[1] - 1], "pl-sub2")
+    for attempt in range(60):
+        ack = await sub2.subscribe("pl/t", qos=1)
+        if ack.reason_codes[0] < 0x80:
+            break
+        await asyncio.sleep(0.5)
+    else:
+        raise AssertionError("subscription never committed on majority side")
+    await asyncio.sleep(1.0)  # publish under the new leader
+    cc.heal(leader)
+    await asyncio.sleep(1.0)
+    stop_evt.set()
+    await stream
+    # at-least-once: every acked publish reaches the original subscriber
+    want = set(acked)
+    assert want, "publisher never got an ack"
+    got = await _drain_until(sub, want, timeout=30.0)
+    missing = want - got
+    assert not missing, f"{len(missing)}/{len(want)} acked messages lost: {sorted(missing)[:5]}"
+
+
+@_chaos_test
+async def test_chaos_iterated_follower_kill_under_load(cc):
+    """Iterated kill/restart (chaos restart.rs): SIGKILL a follower twice
+    while publishing; acked messages between two live-node clients are
+    never lost, and the restarted process rejoins."""
+    leader = await cc.wait_leader(via=1)
+    others = [n for n in (1, 2, 3) if n != leader]
+    victim = others[1]
+    sub = await TestClient.connect(cc.mports[leader - 1], "ik-sub")
+    for attempt in range(60):
+        ack = await sub.subscribe("ik/t", qos=1)
+        if ack.reason_codes[0] < 0x80:
+            break
+        await asyncio.sleep(0.5)
+    else:
+        raise AssertionError("ik-sub subscription never committed")
+    pub = await TestClient.connect(cc.mports[others[0] - 1], "ik-pub")
+    stop_evt, acked = asyncio.Event(), []
+    stream = asyncio.create_task(_publish_stream(pub, "ik/t", stop_evt, acked))
+    for round_ in range(2):
+        await asyncio.sleep(0.8)
+        cc.procs[victim].kill()  # SIGKILL: no clean shutdown
+        cc.procs[victim].wait(timeout=10)
+        await asyncio.sleep(0.8)
+        peers = [(j, cc.pport[(victim, j)]) for j in (1, 2, 3) if j != victim]
+        cc.procs[victim] = _spawn_node(
+            victim, cc.mports[victim - 1], cc.cports[victim - 1], peers
+        )
+        await asyncio.get_running_loop().run_in_executor(
+            None, _wait_port, cc.mports[victim - 1]
+        )
+    stop_evt.set()
+    await stream
+    want = set(acked)
+    assert want
+    got = await _drain_until(sub, want, timeout=30.0)
+    missing = want - got
+    assert not missing, f"{len(missing)}/{len(want)} acked messages lost"
+
+
+@_chaos_test
+async def test_chaos_flaky_links_survive_and_recover(cc):
+    """Packet-loss analogue (chaos packet_loss.rs): every cluster link
+    aborts after 32KB, forcing constant reconnects. Cross-node ForwardsTo
+    is fire-and-forget (like the reference's gRPC notify,
+    cluster-raft/src/shared.rs:490-530), so in-flight fan-outs may be lost
+    WHILE links are flapping — the invariants are (a) delivery keeps
+    happening through the flapping (links recover via reconnect), and
+    (b) after the links stabilize, cross-node delivery is again lossless."""
+    await cc.wait_leader(via=1)
+    sub = await TestClient.connect(cc.mports[0], "fl-sub")
+    for attempt in range(60):
+        ack = await sub.subscribe("fl/t", qos=1)
+        if ack.reason_codes[0] < 0x80:
+            break
+        await asyncio.sleep(0.5)
+    else:
+        raise AssertionError("fl-sub subscription never committed")
+    pub = await TestClient.connect(cc.mports[1], "fl-pub")
+    cc.flaky_all(32 * 1024)
+    stop_evt, acked = asyncio.Event(), []
+    stream = asyncio.create_task(_publish_stream(pub, "fl/t", stop_evt, acked))
+    await asyncio.sleep(4.0)  # several link-abort cycles at raft heartbeat volume
+    stop_evt.set()
+    await stream
+    flaky_got = await _drain_until(sub, set(acked), timeout=10.0)
+    assert flaky_got, "no cross-node delivery at all under flaky links"
+    # heal; everything acked from here on must arrive
+    cc.steady_all()
+    await asyncio.sleep(1.0)
+    stop2, acked2 = asyncio.Event(), []
+    stream2 = asyncio.create_task(
+        _publish_stream(pub, "fl/t", stop2, acked2, prefix="healed"))
+    await asyncio.sleep(2.0)
+    stop2.set()
+    await stream2
+    want = set(acked2)
+    assert want
+    got = await _drain_until(sub, want, timeout=30.0)
+    missing = want - got
+    assert not missing, f"{len(missing)}/{len(want)} acked messages lost after heal"
