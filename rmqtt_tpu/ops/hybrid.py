@@ -1,0 +1,150 @@
+"""Adaptive hybrid matcher: host trie vs device kernel, chosen by measurement.
+
+The deployed router keeps two match engines for the same filter set: a
+host-side trie (µs-scale per topic, the reference's own data structure,
+`/root/reference/rmqtt/src/trie.rs:288-408`) and the batched device
+automaton (`ops/partitioned.py`). Which one is faster depends on scale and
+placement: at small tables or over a high-RTT tunnel the trie wins at any
+batch size; at 1M+ wildcard subs the device path wins on bursts (NOTES.md
+measured both regimes). A fixed size threshold can't know which regime it
+is in — so the hybrid measures.
+
+Policy:
+- batches ≤ ``small_max`` always take the trie (per-message latency
+  contract of `rmqtt/src/shared.rs:735-820`; a device dispatch per 1-topic
+  publish costs a full round trip);
+- larger batches go to whichever path's throughput EMA is higher; every
+  ``probe_every``-th large batch runs on the slower path to refresh its
+  EMA, so regime changes (table growth, co-located vs tunneled chip) flip
+  the routing within a bounded number of batches;
+- with no device matcher (or no trie side) the surviving path serves
+  everything.
+
+``match_submit``/``match_complete`` preserve the device path's pipelining
+(dispatch N+1 overlaps compute N) — the bench and the RoutingService both
+drive it; trie-served batches complete synchronously inside submit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+EMA_ALPHA = 0.3  # weight of the newest rate sample
+
+
+class AdaptiveHybrid:
+    def __init__(self, side, matcher, small_max: int = 64,
+                 probe_every: int = 64) -> None:
+        self.side = side  # NativeTrie-like: .match(topic) -> fid ndarray
+        self.matcher = matcher  # device matcher: .match(list) / submit/complete
+        self.small_max = small_max
+        self.probe_every = probe_every
+        self._rate = {"side": None, "device": None}  # EMA topics/s
+        self._n_large = 0
+        self._dev_samples = 0  # first device sample includes XLA compile
+        self._last_dev_complete = None  # for pipelined-rate attribution
+
+    # ------------------------------------------------------------- internals
+    def _bump(self, key: str, rate: float) -> None:
+        cur = self._rate[key]
+        if cur is None or rate > 2.5 * cur or rate < cur / 2.5:
+            # regime jump (compile finished, chip co-located, table grew):
+            # converge immediately instead of over many EMA steps
+            self._rate[key] = rate
+        else:
+            self._rate[key] = (1 - EMA_ALPHA) * cur + EMA_ALPHA * rate
+
+    def _bump_device(self, n: int, dt: float) -> None:
+        """Device samples skip the first call — it includes JIT compile
+        (seconds to minutes at scale) and would pin routing to the trie
+        for hundreds of probe cycles."""
+        self._dev_samples += 1
+        if self._dev_samples > 1 and dt > 0:
+            self._bump("device", n / dt)
+
+    def _side_match(self, topics: Sequence[str]) -> List[np.ndarray]:
+        t0 = time.perf_counter()
+        if len(topics) > 1 and hasattr(self.side, "match_batch"):
+            # one native call for the whole batch: the per-topic ctypes
+            # round trip (~7µs) would otherwise dominate and misprice the
+            # trie side at large batch sizes
+            rows = self.side.match_batch(list(topics))
+        else:
+            rows = [self.side.match(t) for t in topics]
+        dt = time.perf_counter() - t0
+        if len(topics) > self.small_max and dt > 0:
+            self._bump("side", len(topics) / dt)
+        return rows
+
+    def _device_match(self, topics: Sequence[str]) -> List[np.ndarray]:
+        t0 = time.perf_counter()
+        rows = self.matcher.match(topics)
+        self._bump_device(len(topics), time.perf_counter() - t0)
+        self._last_dev_complete = time.perf_counter()
+        return rows
+
+    def _pick(self) -> str:
+        """Route a large batch; probes keep the loser's EMA fresh."""
+        if self.probe_every <= 0:
+            return "device"  # adaptivity off: fixed size threshold only
+        self._n_large += 1
+        s, d = self._rate["side"], self._rate["device"]
+        if d is None:
+            return "device"
+        if s is None:
+            return "side"
+        if self._n_large % self.probe_every == 0:
+            return "side" if s < d else "device"  # probe the slower path
+        return "side" if s >= d else "device"
+
+    # ------------------------------------------------------------------ api
+    @property
+    def choice(self) -> Optional[str]:
+        """Current steady-state routing for large batches (None = unprimed)."""
+        s, d = self._rate["side"], self._rate["device"]
+        if s is None or d is None:
+            return None
+        return "side" if s >= d else "device"
+
+    def match(self, topics: Sequence[str]) -> List[np.ndarray]:
+        if self.side is None:
+            return self._device_match(topics)
+        if self.matcher is None or len(topics) <= self.small_max:
+            return self._side_match(topics)
+        if self._pick() == "side":
+            return self._side_match(topics)
+        return self._device_match(topics)
+
+    def match_submit(self, topics: Sequence[str]):
+        """Pipelined form: device submissions stay asynchronous; trie-served
+        batches resolve inside submit (they are µs-scale)."""
+        if self.side is None or (
+            self.matcher is not None and len(topics) > self.small_max
+            and self._pick() == "device"
+        ):
+            if hasattr(self.matcher, "match_submit"):
+                return ("device", self.matcher.match_submit(topics),
+                        len(topics), time.perf_counter())
+            return ("sync", self._device_match(topics))
+        return ("sync", self._side_match(topics))
+
+    def match_complete(self, handle) -> List[np.ndarray]:
+        if handle[0] == "sync":
+            return handle[1]
+        _kind, payload, n, t_submit = handle
+        rows = self.matcher.match_complete(payload)
+        now = time.perf_counter()
+        last = self._last_dev_complete
+        if last is not None and last > t_submit:
+            # a device completion landed after this submit: the pipeline is
+            # overlapped, so the inter-completion gap IS the per-batch cost
+            self._bump_device(n, now - last)
+        else:
+            # lone dispatch (e.g. a probe among trie-served batches): the
+            # serial round trip is the honest rate
+            self._bump_device(n, now - t_submit)
+        self._last_dev_complete = now
+        return rows

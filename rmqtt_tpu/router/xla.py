@@ -114,6 +114,8 @@ class XlaRouter(Router):
         # of `/root/reference/rmqtt/src/shared.rs:735-820`.
         import os
 
+        from rmqtt_tpu.ops.hybrid import AdaptiveHybrid
+
         self._hybrid_max = int(os.environ.get("RMQTT_HYBRID_MAX", "64"))
         self._side = None
         self._side_native = False
@@ -127,6 +129,19 @@ class XlaRouter(Router):
                 from rmqtt_tpu.core.trie import TopicTree
 
                 self._side = _TreeSide(TopicTree())
+        # large batches route adaptively between the trie mirror and the
+        # device (ops/hybrid.py): which path wins depends on table scale
+        # and chip placement, so the hybrid measures instead of assuming.
+        # Adaptivity needs the µs-scale NATIVE trie (the Python fallback
+        # only serves the sub-threshold latency path); RMQTT_HYBRID_ADAPT=0
+        # pins large batches to the device.
+        probe = int(os.environ.get("RMQTT_PROBE_EVERY", "64"))
+        if not self._side_native or os.environ.get("RMQTT_HYBRID_ADAPT", "1") != "1":
+            probe = 0
+        self._hybrid = AdaptiveHybrid(
+            self._side, self.matcher, small_max=self._hybrid_max,
+            probe_every=probe,
+        )
 
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
         if self._relations.add(topic_filter, id, opts):
@@ -140,6 +155,7 @@ class XlaRouter(Router):
                     # for a fast path that no longer is one — drop it; the
                     # device path serves every batch size
                     self._side = None
+                    self._hybrid.side = None
                 else:
                     self._side.add(topic_filter, fid)
 
@@ -166,10 +182,7 @@ class XlaRouter(Router):
 
     def matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
         topics = [topic for _, topic in items]
-        if self._side is not None and len(topics) <= self._hybrid_max:
-            fid_rows = [self._side.match(t) for t in topics]
-        else:
-            fid_rows = self.matcher.match(topics)
+        fid_rows = self._hybrid.match(topics)
         out = []
         f2f = self._fid_to_filter
         for (from_id, _topic), fids in zip(items, fid_rows):

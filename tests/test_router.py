@@ -159,6 +159,7 @@ def test_hybrid_small_batch_uses_side_trie_and_agrees():
     x2 = XlaRouter()
     x2._hybrid_max = 0
     x2._side = None
+    x2._hybrid.side = None  # pin every batch to the device matcher
     for i, f in enumerate(filters):
         x2.add(f, Id(1, f"c{i}"), SubscriptionOptions(qos=0))
     big = x2.matches_batch_raw([(None, t) for t in topics])
@@ -177,3 +178,70 @@ def test_hybrid_small_batch_uses_side_trie_and_agrees():
         out, _sh = x.matches_raw(None, t)
         assert all(r.topic_filter != "a/#" for rels in out.values() for r in rels), t
     assert x.is_match("a/1/c") and not x.is_match("q/q/q/q")
+
+
+def test_adaptive_hybrid_routing():
+    """ops/hybrid.py: small batches pin to the trie side; large batches
+    flow to whichever path measures faster, and periodic probes let the
+    decision flip when the regime changes."""
+    import numpy as np
+
+    from rmqtt_tpu.ops.hybrid import AdaptiveHybrid
+
+    class FakeSide:
+        def __init__(self):
+            self.delay = 0.0
+            self.calls = 0
+
+        def match(self, topic):
+            self.calls += 1
+            if self.delay:
+                import time
+                time.sleep(self.delay)
+            return np.asarray([1], dtype=np.int64)
+
+    class FakeDevice:
+        def __init__(self):
+            self.delay = 0.0
+            self.calls = 0
+
+        def match(self, topics):
+            self.calls += 1
+            if self.delay:
+                import time
+                time.sleep(self.delay)
+            return [np.asarray([1], dtype=np.int64) for _ in topics]
+
+    side, dev = FakeSide(), FakeDevice()
+    h = AdaptiveHybrid(side, dev, small_max=4, probe_every=8)
+    # small batches never touch the device
+    h.match(["a/b"])
+    assert dev.calls == 0 and side.calls == 1
+    # first large batches prime both paths; device is slow -> side wins
+    dev.delay = 0.02
+    for _ in range(12):
+        h.match([f"t/{i}" for i in range(16)])
+    assert h.choice == "side"
+    side_before = dev.calls
+    for _ in range(7):
+        h.match([f"t/{i}" for i in range(16)])
+    # regime change: device becomes fast, side slow; probes flip the choice
+    dev.delay = 0.0
+    side.delay = 0.005
+    for _ in range(40):
+        h.match([f"t/{i}" for i in range(16)])
+    assert h.choice == "device", (h._rate, dev.calls)
+    # probing continued to exercise the device while side was preferred
+    assert dev.calls > side_before
+
+    # adaptivity off (probe_every=0): large batches always device
+    side2, dev2 = FakeSide(), FakeDevice()
+    h2 = AdaptiveHybrid(side2, dev2, small_max=4, probe_every=0)
+    h2.match([f"t/{i}" for i in range(16)])
+    h2.match(["one"])
+    assert dev2.calls == 1 and side2.calls == 1
+
+    # submit/complete pipelined form delegates per decision
+    h3 = AdaptiveHybrid(None, dev2, small_max=4, probe_every=8)
+    rows = h3.match_complete(h3.match_submit(["x", "y"]))
+    assert len(rows) == 2
