@@ -32,25 +32,47 @@ class RoutingService:
         max_batch: int = 1024,
         linger_ms: float = 0.0,
         max_queue: int = 100_000,
+        pipeline_depth: int = 3,
     ) -> None:
         self.router = router
         self.max_batch = max_batch
         self.linger = linger_ms / 1000.0
         self._q: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: Optional[asyncio.Task] = None
+        # pipelined dispatch (routers exposing submit/complete halves):
+        # up to pipeline_depth batches in flight — batch N+1's host encode
+        # and dispatch overlap batch N's device compute, so burst latency
+        # approaches the slowest stage instead of the sum of stages. The
+        # semaphore is the in-flight bound (acquired before submit, released
+        # after completion); pipeline_depth=1 degrades to serial dispatch.
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._pipe_sem: Optional[asyncio.Semaphore] = None  # built in start()
+        self._completion_q: asyncio.Queue = asyncio.Queue()
+        self._completer: Optional[asyncio.Task] = None
 
     def start(self) -> None:
+        loop = asyncio.get_running_loop()
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._task = loop.create_task(self._run())
+        if self._completer is None and hasattr(self.router, "submit_batch_raw"):
+            self._pipe_sem = asyncio.Semaphore(self.pipeline_depth)
+            self._completer = loop.create_task(self._complete_loop())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        for name in ("_task", "_completer"):
+            t = getattr(self, name)
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, name, None)
+        # reject batches still queued for completion — their waiters would
+        # otherwise await forever (e.g. forwards() during broker shutdown)
+        while not self._completion_q.empty():
+            batch, _handle = self._completion_q.get_nowait()
+            self._reject(batch, RuntimeError("routing service stopped"))
 
     async def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
         # NOTE: even for prefer_inline routers the queue round trip stays —
@@ -87,6 +109,17 @@ class RoutingService:
                     break
         return batch
 
+    def _resolve(self, batch, results) -> None:
+        for (_, _, fut, raw), res in zip(batch, results):
+            if not fut.done():
+                fut.set_result(res if raw else self.router.collapse(res))
+
+    @staticmethod
+    def _reject(batch, exc) -> None:
+        for _, _, fut, _ in batch:
+            if not fut.done():
+                fut.set_exception(exc)
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         # CPU routers (trie/native) match in microseconds: a thread-pool hop
@@ -94,21 +127,54 @@ class RoutingService:
         # publish throughput. Device routers keep the executor (the kernel
         # blocks; numpy/jax release the GIL for the heavy parts).
         inline_ok = self.router.inline_ok
+        pipelined = hasattr(self.router, "submit_batch_raw")
         while True:
             batch = await self._collect()
             items = [(fid, topic) for fid, topic, _, _ in batch]
-            try:
-                if inline_ok(len(items)):
-                    results = self.router.matches_batch_raw(items)
-                else:
-                    results = await loop.run_in_executor(
-                        None, self.router.matches_batch_raw, items
-                    )
-            except Exception as e:  # resolve all waiters with the error
-                for _, _, fut, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+            if inline_ok(len(items)):
+                try:
+                    self._resolve(batch, self.router.matches_batch_raw(items))
+                except Exception as e:
+                    self._reject(batch, e)
                 continue
-            for (_, _, fut, raw), res in zip(batch, results):
-                if not fut.done():
-                    fut.set_result(res if raw else self.router.collapse(res))
+            if pipelined:
+                # in-flight bound: block BEFORE submitting so at most
+                # pipeline_depth batches are ever past submit
+                await self._pipe_sem.acquire()
+                try:
+                    handle = await loop.run_in_executor(
+                        None, self.router.submit_batch_raw, items
+                    )
+                except Exception as e:
+                    self._pipe_sem.release()
+                    self._reject(batch, e)
+                    continue
+                await self._completion_q.put((batch, handle))
+                continue
+            try:
+                results = await loop.run_in_executor(
+                    None, self.router.matches_batch_raw, items
+                )
+            except Exception as e:  # resolve all waiters with the error
+                self._reject(batch, e)
+                continue
+            self._resolve(batch, results)
+
+    async def _complete_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            batch, handle = await self._completion_q.get()
+            try:
+                results = await loop.run_in_executor(
+                    None, self.router.complete_batch_raw, handle
+                )
+            except asyncio.CancelledError:
+                # shutdown mid-completion: don't strand these waiters
+                self._reject(batch, RuntimeError("routing service stopped"))
+                raise
+            except Exception as e:
+                self._reject(batch, e)
+            else:
+                self._resolve(batch, results)
+            finally:
+                self._pipe_sem.release()

@@ -245,3 +245,85 @@ def test_adaptive_hybrid_routing():
     h3 = AdaptiveHybrid(None, dev2, small_max=4, probe_every=8)
     rows = h3.match_complete(h3.match_submit(["x", "y"]))
     assert len(rows) == 2
+
+
+def test_routing_service_pipelined_overlap():
+    """RoutingService keeps up to pipeline_depth batches in flight when the
+    router exposes submit/complete halves: submissions overlap a slow
+    completion, every waiter resolves with its own result, and errors in
+    either half reject only their batch."""
+    import asyncio
+    import threading
+    import time as _time
+
+    from rmqtt_tpu.broker.routing import RoutingService
+
+    class PipelinedFake:
+        prefer_inline = False
+
+        def __init__(self):
+            self.max_inflight = 0
+            self._inflight = 0
+            self._lock = threading.Lock()
+            self.fail_submit = False
+            self.fail_complete = False
+
+        def inline_ok(self, n):
+            return False
+
+        def submit_batch_raw(self, items):
+            if self.fail_submit:
+                raise RuntimeError("submit boom")
+            with self._lock:
+                self._inflight += 1
+                self.max_inflight = max(self.max_inflight, self._inflight)
+            return list(items)
+
+        def complete_batch_raw(self, items):
+            _time.sleep(0.05)  # slow device phase
+            if self.fail_complete:
+                with self._lock:
+                    self._inflight -= 1
+                raise RuntimeError("complete boom")
+            with self._lock:
+                self._inflight -= 1
+            return [({1: [(fid, topic)]}, {}) for fid, topic in items]
+
+        def collapse(self, raw):
+            return raw[0]
+
+    async def run():
+        r = PipelinedFake()
+        svc = RoutingService(r, max_batch=4, pipeline_depth=3)
+        svc.start()
+        try:
+            outs = await asyncio.gather(
+                *(svc.matches(None, f"t/{i}") for i in range(24))
+            )
+            for i, out in enumerate(outs):
+                assert out == {1: [(None, f"t/{i}")]}
+            assert r.max_inflight >= 2, (
+                f"no overlap: max in-flight {r.max_inflight}"
+            )
+            # submit failure rejects just that batch; service keeps serving
+            r.fail_submit = True
+            try:
+                await svc.matches(None, "x")
+                raise AssertionError("expected submit error")
+            except RuntimeError:
+                pass
+            r.fail_submit = False
+            assert (await svc.matches(None, "y")) == {1: [(None, "y")]}
+            # completion failure also rejects cleanly
+            r.fail_complete = True
+            try:
+                await svc.matches(None, "z")
+                raise AssertionError("expected complete error")
+            except RuntimeError:
+                pass
+            r.fail_complete = False
+            assert (await svc.matches(None, "w")) == {1: [(None, "w")]}
+        finally:
+            await svc.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
