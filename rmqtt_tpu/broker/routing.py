@@ -68,11 +68,14 @@ class RoutingService:
                 except asyncio.CancelledError:
                     pass
                 setattr(self, name, None)
-        # reject batches still queued for completion — their waiters would
-        # otherwise await forever (e.g. forwards() during broker shutdown)
+        # reject everything still parked in either queue — those waiters
+        # would otherwise await forever (e.g. forwards() during shutdown)
         while not self._completion_q.empty():
             batch, _handle = self._completion_q.get_nowait()
             self._reject(batch, RuntimeError("routing service stopped"))
+        while not self._q.empty():
+            item = self._q.get_nowait()
+            self._reject([item], RuntimeError("routing service stopped"))
 
     async def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
         # NOTE: even for prefer_inline routers the queue round trip stays —
@@ -111,8 +114,14 @@ class RoutingService:
 
     def _resolve(self, batch, results) -> None:
         for (_, _, fut, raw), res in zip(batch, results):
-            if not fut.done():
+            if fut.done():
+                continue
+            try:
                 fut.set_result(res if raw else self.router.collapse(res))
+            except Exception as e:
+                # a collapse failure (e.g. a shared-sub strategy callback
+                # bug) must reject ITS waiter, not kill the service task
+                fut.set_exception(e)
 
     @staticmethod
     def _reject(batch, exc) -> None:
@@ -130,35 +139,54 @@ class RoutingService:
         pipelined = hasattr(self.router, "submit_batch_raw")
         while True:
             batch = await self._collect()
-            items = [(fid, topic) for fid, topic, _, _ in batch]
-            if inline_ok(len(items)):
-                try:
-                    self._resolve(batch, self.router.matches_batch_raw(items))
-                except Exception as e:
-                    self._reject(batch, e)
-                continue
-            if pipelined:
-                # in-flight bound: block BEFORE submitting so at most
-                # pipeline_depth batches are ever past submit
-                await self._pipe_sem.acquire()
-                try:
-                    handle = await loop.run_in_executor(
-                        None, self.router.submit_batch_raw, items
-                    )
-                except Exception as e:
-                    self._pipe_sem.release()
-                    self._reject(batch, e)
-                    continue
-                await self._completion_q.put((batch, handle))
-                continue
             try:
-                results = await loop.run_in_executor(
-                    None, self.router.matches_batch_raw, items
-                )
-            except Exception as e:  # resolve all waiters with the error
+                await self._dispatch_one(loop, batch, inline_ok, pipelined)
+            except asyncio.CancelledError:
+                # shutdown while this batch was mid-dispatch: its waiters
+                # must not hang (stop()'s drain only sees the queues)
+                self._reject(batch, RuntimeError("routing service stopped"))
+                raise
+
+    async def _dispatch_one(self, loop, batch, inline_ok, pipelined) -> None:
+        items = [(fid, topic) for fid, topic, _, _ in batch]
+        if inline_ok(len(items)):
+            try:
+                self._resolve(batch, self.router.matches_batch_raw(items))
+            except Exception as e:
                 self._reject(batch, e)
-                continue
-            self._resolve(batch, results)
+            return
+        if pipelined:
+            # in-flight bound: block BEFORE submitting so at most
+            # pipeline_depth batches are ever past submit
+            await self._pipe_sem.acquire()
+            try:
+                done, payload = await loop.run_in_executor(
+                    None, self.router.submit_batch_raw, items
+                )
+            except Exception as e:
+                self._pipe_sem.release()
+                self._reject(batch, e)
+                return
+            except asyncio.CancelledError:
+                self._pipe_sem.release()
+                raise
+            if done:
+                # the router resolved synchronously (e.g. the hybrid served
+                # it from the host trie): don't spend a pipeline permit or
+                # a completion-queue round trip on it
+                self._pipe_sem.release()
+                self._resolve(batch, payload)
+                return
+            await self._completion_q.put((batch, payload))
+            return
+        try:
+            results = await loop.run_in_executor(
+                None, self.router.matches_batch_raw, items
+            )
+        except Exception as e:  # resolve all waiters with the error
+            self._reject(batch, e)
+            return
+        self._resolve(batch, results)
 
     async def _complete_loop(self) -> None:
         loop = asyncio.get_running_loop()

@@ -1,12 +1,19 @@
-"""Pallas TPU kernel for the partitioned-match inner loop.
+"""Pallas TPU kernel for the partitioned-match inner loop (BT-wave form).
 
 Replaces the ``lax.scan`` body of `ops/partitioned.py::match_partitioned_impl`
-(gather chunk tile → level match → pack bits) with a hand-pipelined kernel:
-per (topic, candidate-chunk) step, the field-major [L+3, CHUNK] filter tile
-is DMA'd HBM→VMEM double-buffered while the previous tile is matched and
-bit-packed, so the tile never materializes as an XLA intermediate and DMA
-overlaps compute. Grid = one program per ``BT`` topics; per-topic scalars
-(tokens, tlen, tdollar, candidate chunk ids) ride in SMEM.
+(gather chunk tile → level match → pack bits) with a hand-pipelined kernel.
+Grid = one program per ``BT`` topics; each step DMAs a WAVE of BT tiles —
+the 8 topics' k-th candidate chunks — HBM→VMEM double-buffered, then
+matches all BT topics at once as [BT, CHUNK] vectors.
+
+Why waves (round-3 VERDICT item 4): the first-light kernel processed one
+(topic, chunk) per step as [1, CHUNK] rows, using ONE of the VPU's 8
+sublanes — 8× wasted vector throughput, and it lost the race to the lax
+path (132 ms vs 79 ms at cfg3). The wave form does the same DMA volume in
+BT-deep bursts (better DMA pipelining), runs the mask math in full
+(8, 128) vregs, and issues one [BT, CHUNK]×[CHUNK, WPC] MXU bit-pack per
+step instead of 2×BT [1, CHUNK] ones — 8× fewer steps at the same
+per-step cost.
 
 Mosaic-lowering constraints that shaped this kernel (each rejected an
 earlier revision on real TPU — interpret mode hides all of them):
@@ -14,15 +21,17 @@ earlier revision on real TPU — interpret mode hides all of them):
   trunci): every mask is int32; comparisons only feed where(cond, 1, 0);
 - no unsigned reductions: bits pack via int32 sums of distinct powers of
   two (wrap-exact), bitcast to uint32 at the end;
-- vector stores need static lane offsets: the out block is [BT*nc, WPC]
-  (full-row store at a dynamic sublane offset), same contiguous order as
-  the caller's [B, NC*WPC] view;
+- vector stores need static lane offsets: each step stores a full
+  contiguous [BT, WPC] row range at a dynamic sublane offset, so the out
+  block is chunk-major [nc*BT, WPC] — the wrapper transposes back to the
+  caller's [B, NC*WPC] order inside the same jit;
 - HBM DMA slices must be 128-aligned in the minor dim: the table tile is
   field-major [L+3, CHUNK=256] (which also keeps the XLA-side HBM array
   un-padded — see pack_device_rows);
-- dynamic-sublane vector loads from VMEM blocks are avoided entirely: the
-  per-topic values load as SMEM scalars and broadcast, with the (static)
-  level loop unrolled.
+- dynamic-sublane vector loads from VMEM blocks are avoided: per-topic
+  values (tokens/tlen/tdollar) ride as [BT, ·] VMEM blocks read at STATIC
+  level offsets and lane-broadcast; candidate chunk ids stay SMEM scalars
+  (DMA descriptors need scalar indices); the level loop is unrolled.
 
 Semantics are identical to the lax path (same [B, NC*WPC] packed words);
 `PartitionedMatcher` verifies that on-device at first use and falls back if
@@ -42,83 +51,89 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rmqtt_tpu.ops.encode import PLUS_TOK
 
-BT = 8  # topics per program
+BT = 8  # topics per program = one full VPU sublane dimension
 
 
-def _kernel(nc: int, lvl: int, chunk: int, ttok_ref, tlen_ref, tdollar_ref,
-            cid_ref, plo_ref, phi_ref, rows_hbm, out_ref):
-    total = BT * nc
-
+def _kernel(nc: int, lvl: int, chunk: int, cid_ref, ttok_ref, tlen_ref,
+            tdollar_ref, plo_ref, phi_ref, rows_hbm, out_ref):
     def body(scratch, sems):
-        def make_dma(slot, idx):
-            t = idx // nc
-            k = idx % nc
-            cid = cid_ref[t, k]
-            return pltpu.make_async_copy(
-                rows_hbm.at[cid], scratch.at[slot], sems.at[slot]
-            )
+        def start_wave(slot, k):
+            # BT concurrent copies: topic t's k-th candidate tile → lane t
+            for t in range(BT):
+                pltpu.make_async_copy(
+                    rows_hbm.at[cid_ref[t, k]], scratch.at[slot, t],
+                    sems.at[slot, t],
+                ).start()
 
-        make_dma(0, 0).start()
+        def wait_wave(slot, k):
+            for t in range(BT):
+                pltpu.make_async_copy(
+                    rows_hbm.at[cid_ref[t, k]], scratch.at[slot, t],
+                    sems.at[slot, t],
+                ).wait()
 
-        def step(idx, _):
-            slot = idx % 2
+        start_wave(0, 0)
 
-            @pl.when(idx + 1 < total)
+        def step(k, _):
+            slot = k % 2
+
+            @pl.when(k + 1 < nc)
             def _():
-                make_dma((idx + 1) % 2, idx + 1).start()
+                start_wave((k + 1) % 2, k + 1)
 
-            make_dma(slot, idx).wait()
-            t = idx // nc
-            # [L+3, CHUNK] field-major; tiles may ship int16 (half the DMA
-            # bytes) — widen once after load, the mask math stays int32
-            tile = scratch[slot].astype(jnp.int32)
-            flen = tile[lvl : lvl + 1, :]  # [1, CHUNK]
-            plen = tile[lvl + 1 : lvl + 2, :]
-            flags = tile[lvl + 2 : lvl + 3, :]
+            wait_wave(slot, k)
+            # [BT, L+3, CHUNK] field-major; tiles may ship int16 (half the
+            # DMA bytes) — widen once after load, the mask math stays int32
+            tiles = scratch[slot].astype(jnp.int32)
+            flen = tiles[:, lvl, :]  # [BT, CHUNK]
+            plen = tiles[:, lvl + 1, :]
+            flags = tiles[:, lvl + 2, :]
             # count failing levels in int32; a level passes when the filter
             # token equals the topic token, is '+', or lies beyond the
-            # filter's prefix. The level loop is static (unrolled): topic
-            # tokens are SMEM scalars broadcast across the CHUNK lanes.
-            bad = jnp.zeros((1, chunk), jnp.int32)
+            # filter's prefix. Static (unrolled) level loop; topic tokens
+            # are [BT, 1] VMEM columns lane-broadcast across CHUNK.
+            bad = jnp.zeros((BT, chunk), jnp.int32)
             for level in range(lvl):
-                f = tile[level : level + 1, :]  # [1, CHUNK]
+                f = tiles[:, level, :]  # [BT, CHUNK]
+                tt = ttok_ref[:, level : level + 1]  # [BT, 1]
                 e = (
-                    jnp.where(f == ttok_ref[t, level], 1, 0)
+                    jnp.where(f == tt, 1, 0)
                     + jnp.where(f == PLUS_TOK, 1, 0)
                     + jnp.where(plen <= level, 1, 0)
                 )
                 bad = bad + jnp.where(e == 0, 1, 0)
             hh = flags & 1
             fw = jnp.where((flags & 2) != 0, 1, 0)
-            tl = tlen_ref[t, 0]
+            tl = tlen_ref[:, 0:1]  # [BT, 1]
             ge = jnp.where(tl >= plen, 1, 0)
             eqlen = jnp.where(tl == flen, 1, 0)
             len_ok = hh * ge + (1 - hh) * eqlen
-            dollar_bad = tdollar_ref[t, 0] * fw  # tdollar is 0/1
+            dollar_bad = tdollar_ref[:, 0:1] * fw  # tdollar is 0/1
             m32 = jnp.where(bad == 0, 1, 0) * len_ok * (1 - dollar_bad)
             # pack bits on the (otherwise idle) MXU: Mosaic cannot reshape
-            # lanes into sublanes ((1,CHUNK)->(WPC,32)), so word j = Σ
+            # lanes into sublanes ((BT,CHUNK)->(BT*WPC,32)), so word j = Σ
             # m[j*32+i]<<i is computed as two exact f32 matmuls against
             # constant selectors (low/high 16 bits per word — each sum of
             # distinct powers of two stays < 2^16, exact in f32), then
             # recombined in int32 and bitcast to uint32
-            mf = m32.astype(jnp.float32)  # [1, CHUNK]
+            mf = m32.astype(jnp.float32)  # [BT, CHUNK]
             dims = (((1,), (0,)), ((), ()))
             wlo = lax.dot_general(mf, plo_ref[...], dims,
                                   preferred_element_type=jnp.float32)
             whi = lax.dot_general(mf, phi_ref[...], dims,
                                   preferred_element_type=jnp.float32)
             words = wlo.astype(jnp.int32) + (whi.astype(jnp.int32) << 16)
-            out_ref[pl.ds(idx, 1), :] = lax.bitcast_convert_type(
-                words, jnp.uint32  # [1, WPC]
+            # one contiguous [BT, WPC] store per step (chunk-major layout)
+            out_ref[pl.ds(k * BT, BT), :] = lax.bitcast_convert_type(
+                words, jnp.uint32
             )
 
-        lax.fori_loop(0, total, step, None)
+        lax.fori_loop(0, nc, step, None)
 
     pl.run_scoped(
         body,
-        scratch=pltpu.VMEM((2, lvl + 3, chunk), rows_hbm.dtype),
-        sems=pltpu.SemaphoreType.DMA((2,)),
+        scratch=pltpu.VMEM((2, BT, lvl + 3, chunk), rows_hbm.dtype),
+        sems=pltpu.SemaphoreType.DMA((2, BT)),
     )
 
 
@@ -142,24 +157,31 @@ def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
         kernel,
         grid=(b // BT,),
         in_specs=[
-            pl.BlockSpec((BT, lvl), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((BT, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((BT, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BT, nc), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BT, lvl), lambda i: (i, 0)),  # VMEM: lane-broadcast
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
             pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
             pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # packed_rows stays in HBM
         ],
-        out_specs=pl.BlockSpec((BT * nc, wpc), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * nc, wpc), jnp.uint32),
+        out_specs=pl.BlockSpec((nc * BT, wpc), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b // BT * nc * BT, wpc), jnp.uint32),
         interpret=interpret,
     )(
+        chunk_ids.astype(jnp.int32),
         ttok.astype(jnp.int32),
         tlen.astype(jnp.int32).reshape(b, 1),
         tdollar.astype(jnp.int32).reshape(b, 1),
-        chunk_ids.astype(jnp.int32),
         plo,
         phi,
         packed_rows,
     )
-    return out.reshape(b, nc * wpc)
+    # chunk-major [B/BT, nc, BT, WPC] → topic-major [B, NC*WPC] (the
+    # caller's contract); a single XLA transpose-copy, trivial next to the
+    # scan it replaces
+    return (
+        out.reshape(b // BT, nc, BT, wpc)
+        .transpose(0, 2, 1, 3)
+        .reshape(b, nc * wpc)
+    )

@@ -196,10 +196,17 @@ class XlaRouter(Router):
 
     # pipelined halves (RoutingService overlap): submit encodes + dispatches,
     # complete fetches + expands — batch N+1's submit runs while batch N is
-    # still on the device, cutting burst p99 from sum-of-stages to ~max-stage
+    # still on the device, cutting burst p99 from sum-of-stages to ~max-stage.
+    # submit returns (True, results) when the hybrid served the batch
+    # synchronously from the host trie (no pipeline slot needed), else
+    # (False, handle) for complete_batch_raw.
     def submit_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
+        items = list(items)
         topics = [topic for _, topic in items]
-        return (list(items), self._hybrid.match_submit(topics))
+        h = self._hybrid.match_submit(topics)
+        if h[0] == "sync":
+            return True, self._expand(items, h[1])
+        return False, (items, h)
 
     def complete_batch_raw(self, handle):
         items, h = handle

@@ -277,7 +277,7 @@ def test_routing_service_pipelined_overlap():
             with self._lock:
                 self._inflight += 1
                 self.max_inflight = max(self.max_inflight, self._inflight)
-            return list(items)
+            return False, list(items)
 
         def complete_batch_raw(self, items):
             _time.sleep(0.05)  # slow device phase
@@ -325,5 +325,58 @@ def test_routing_service_pipelined_overlap():
             assert (await svc.matches(None, "w")) == {1: [(None, "w")]}
         finally:
             await svc.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_routing_service_sync_fastpath_and_stop_drain():
+    """A (True, results) submit resolves without a pipeline slot; stop()
+    rejects waiters parked anywhere in the service instead of stranding
+    them."""
+    import asyncio
+
+    from rmqtt_tpu.broker.routing import RoutingService
+
+    class SyncFake:
+        prefer_inline = False
+
+        def inline_ok(self, n):
+            return False
+
+        def submit_batch_raw(self, items):
+            return True, [({1: [(fid, topic)]}, {}) for fid, topic in items]
+
+        def complete_batch_raw(self, handle):
+            raise AssertionError("sync-resolved batch must not reach complete")
+
+        def collapse(self, raw):
+            return raw[0]
+
+    class StuckFake(SyncFake):
+        def submit_batch_raw(self, items):
+            import time
+            time.sleep(10)  # never finishes within the test
+            return True, []
+
+    async def run():
+        svc = RoutingService(SyncFake(), max_batch=4, pipeline_depth=2)
+        svc.start()
+        try:
+            out = await asyncio.wait_for(svc.matches(None, "s/1"), 5.0)
+            assert out == {1: [(None, "s/1")]}
+        finally:
+            await svc.stop()
+        # stop() while a batch is stuck mid-submit: the waiter is rejected,
+        # not stranded
+        svc2 = RoutingService(StuckFake(), max_batch=4, pipeline_depth=2)
+        svc2.start()
+        fut = asyncio.ensure_future(svc2.matches(None, "x"))
+        await asyncio.sleep(0.2)  # batch collected, submit in executor
+        await svc2.stop()
+        try:
+            await asyncio.wait_for(fut, 5.0)
+            raise AssertionError("expected rejection on stop")
+        except RuntimeError:
+            pass
 
     asyncio.run(asyncio.wait_for(run(), 30))
