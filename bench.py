@@ -574,7 +574,12 @@ def main():
         if args.config is not None:
             return i == args.config
         if reduced:
-            return i <= 3  # CPU fallback: reduced cfg1-3
+            # CPU fallback: ALL five configs at reduced-but-nontrivial
+            # sizes — cfg4/cfg5's code paths (shared+zipf, retained
+            # interleave, segmented tables) must be exercised even in a
+            # wedged-chip round, and the artifact carries a number for
+            # every config (round 3's fallback skipped 4-5 entirely)
+            return i <= 5
         # on real TPU the default is ALL FIVE baseline configs
         return i <= 3 or args.full or on_tpu
 
@@ -641,18 +646,23 @@ def main():
 
     if want(4):
         def cfg4():
-            filters = gen_mixed(rng, 10_000_000, shared_frac=0.1)
-            topics = gen_topics_zipf(rng, 16_384)
-            return run_config("cfg4_shared_10m_zipf", filters, topics, 8192, 64)
+            n, nt, bs, cs = ((200_000, 4_096, 2048, 64) if reduced
+                             else (10_000_000, 16_384, 8192, 64))
+            filters = gen_mixed(rng, n, shared_frac=0.1)
+            topics = gen_topics_zipf(rng, nt)
+            return run_config("cfg4_shared_10m_zipf", filters, topics, bs, cs)
 
         guarded("cfg4_shared_10m_zipf", cfg4)
 
     if want(5):
         def cfg5():
-            filters = gen_mixed(rng, 10_000_000, shared_frac=0.05)
-            topics = gen_topics_zipf(rng, 16_384)
-            retained = list({_tree_topic(rng, rng.randint(3, 6)) for _ in range(1_000_000)})
-            return run_config("cfg5_retained_10m", filters, topics, 8192, 64, retained=retained)
+            n, nt, bs, cs, nr = ((200_000, 4_096, 2048, 64, 50_000) if reduced
+                                 else (10_000_000, 16_384, 8192, 64, 1_000_000))
+            filters = gen_mixed(rng, n, shared_frac=0.05)
+            topics = gen_topics_zipf(rng, nt)
+            retained = list({_tree_topic(rng, rng.randint(3, 6)) for _ in range(nr)})
+            return run_config("cfg5_retained_10m", filters, topics, bs, cs,
+                              retained=retained)
 
         guarded("cfg5_retained_10m", cfg5)
 
