@@ -91,7 +91,7 @@ def s_partitioned():
         table.add(f)
     m = PartitionedMatcher(table)
     _check(m, ORACLE, TOPICS[:64])
-    return {"nchunks": len(table.chunks) if hasattr(table, "chunks") else None}
+    return {"nchunks": table.nchunks}
 
 
 @step("dense_match")
@@ -112,8 +112,12 @@ def s_ncsplit():
 
     from rmqtt_tpu.ops.partitioned import PartitionedMatcher, PartitionedTable
 
-    prior = os.environ.get("RMQTT_NC_SPLIT")
+    # pin pallas OFF for this step: when the kernel wins its race the
+    # match path returns before _split_plan is consulted and the
+    # engagement assertion would fail spuriously on healthy hardware
+    prior = {k: os.environ.get(k) for k in ("RMQTT_NC_SPLIT", "RMQTT_PALLAS")}
     os.environ["RMQTT_NC_SPLIT"] = "1"
+    os.environ["RMQTT_PALLAS"] = "0"
     try:
         # a denser filter set (tiny vocab → fat concrete partitions) pushes
         # nc past the split's >8 floor; the spy asserts the split actually
@@ -142,10 +146,11 @@ def s_ncsplit():
         assert any(engaged), "NC split never engaged (batch/nc below floors)"
         return {"engaged": True}
     finally:
-        if prior is None:
-            os.environ.pop("RMQTT_NC_SPLIT", None)
-        else:
-            os.environ["RMQTT_NC_SPLIT"] = prior
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 @step("segmented_tables")
@@ -278,6 +283,12 @@ def main() -> int:
 
     platform = jax.devices()[0].platform
     print(f"platform={platform} devices={n}")
+    if "--cpu" not in sys.argv and platform != "tpu":
+        # a grant-less (but unwedged) host silently falls back to CPU:
+        # an all-ok artifact from there would be false on-chip confidence
+        print("not a TPU platform; refusing to write a chip artifact "
+              "(use --cpu for the self-test mode)")
+        return 2
 
     global FILTERS, TOPICS, ORACLE
     import random
