@@ -197,3 +197,75 @@ def test_client_side_codec_version_follows_encoded_connect():
     c = MqttCodec()  # defaults to v3.1.1
     c.encode(Connect(client_id="c", protocol=pk.V5))
     assert c.version == pk.V5
+
+
+def test_codec_random_garbage_never_crashes():
+    """Robustness: arbitrary bytes must produce packets or ProtocolViolation
+    — never an unhandled exception (the reference's size-capped, validated
+    decode, rmqtt-codec/src/v3/codec.rs + v5/codec.rs:250). Runs both the
+    pure-Python and (when built) C++ scan paths via fresh codecs."""
+    import random
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.codec.primitives import ProtocolViolation
+
+    from rmqtt_tpu.broker.codec import codec as codec_mod
+
+    rng = random.Random(99)
+    for version in (pk.V311, pk.V5):
+        for trial in range(400):
+            c = MqttCodec(version)
+            # half the trials exceed NATIVE_MIN_BYTES so the C++ frame
+            # scanner (when built) fuzzes too, not just the Python decoder
+            hi = 300 if trial % 2 else codec_mod.NATIVE_MIN_BYTES * 3
+            n = rng.randint(1, hi)
+            data = bytes(rng.randrange(256) for _ in range(n))
+            try:
+                # split across feeds to exercise resync/partial paths
+                cut = rng.randrange(n + 1)
+                c.feed(data[:cut])
+                c.feed(data[cut:])
+            except ProtocolViolation as e:
+                assert isinstance(e.reason_code, int)
+            except Exception as e:  # pragma: no cover
+                raise AssertionError(
+                    f"v{version} trial {trial}: {type(e).__name__}: {e} "
+                    f"on {data.hex()}"
+                ) from e
+
+
+def test_codec_mutated_valid_frames_never_crash():
+    """Bit-flip mutations of real frames: decode or reject cleanly."""
+    import random
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.codec.primitives import ProtocolViolation
+
+    rng = random.Random(7)
+    base = MqttCodec(pk.V5)
+    frames = [
+        base.encode(pk.Connect(client_id="fz", protocol=pk.V5)),
+        base.encode(pk.Publish(topic="a/b", payload=b"xyz", qos=1,
+                               packet_id=3, properties={1: 1})),
+        base.encode(pk.Subscribe(7, [("a/+", pk.SubOpts(qos=2))], {})),
+        base.encode(pk.Disconnect(0)),
+    ]
+    from rmqtt_tpu.broker.codec import codec as codec_mod
+
+    for trial in range(600):
+        # a run of frames long enough to engage the native scanner on
+        # even trials; a single short frame (Python path) on odd ones
+        reps = 1 if trial % 2 else (
+            codec_mod.NATIVE_MIN_BYTES // len(frames[0]) + 2)
+        frame = bytearray(b"".join(rng.choice(frames) for _ in range(reps)))
+        for _ in range(rng.randint(1, 4)):
+            frame[rng.randrange(len(frame))] ^= 1 << rng.randrange(8)
+        c = MqttCodec(pk.V5)
+        try:
+            c.feed(bytes(frame))
+        except ProtocolViolation:
+            pass
+        except Exception as e:  # pragma: no cover
+            raise AssertionError(
+                f"trial {trial}: {type(e).__name__}: {e} on {bytes(frame).hex()}"
+            ) from e
