@@ -220,8 +220,14 @@ class HttpApi:
         if path in ("/api/v1", "/api/v1/"):
             return 200, [
                 "/api/v1/brokers", "/api/v1/nodes", "/api/v1/health",
-                "/api/v1/clients", "/api/v1/subscriptions", "/api/v1/routes",
-                "/api/v1/stats", "/api/v1/metrics", "/api/v1/plugins",
+                "/api/v1/clients", "/api/v1/clients/{clientid}",
+                "/api/v1/clients/{clientid}/online", "/api/v1/clients/offlines",
+                "/api/v1/subscriptions", "/api/v1/subscriptions/search",
+                "/api/v1/subscriptions/{clientid}",
+                "/api/v1/routes", "/api/v1/routes/{topic}",
+                "/api/v1/stats", "/api/v1/stats/sum",
+                "/api/v1/metrics", "/api/v1/metrics/sum",
+                "/api/v1/plugins", "/api/v1/plugins/{plugin}",
                 "/api/v1/mqtt/publish", "/api/v1/mqtt/subscribe",
                 "/api/v1/mqtt/unsubscribe", "/metrics/prometheus",
             ], J
@@ -238,6 +244,43 @@ class HttpApi:
                 ctx, M.CLIENTS_GET, {"limit": limit}, lambda r: r.get("clients", [])
             )
             return 200, rows[: limit], J
+        if path == "/api/v1/clients/offlines":
+            # offline (disconnected but persistent) sessions, cluster-wide;
+            # DELETE purges them everywhere (api.rs clients/offlines). NOTE:
+            # like the reference's route table, the literal segment wins
+            # over a client actually named "offlines".
+            offl = [s for s in ctx.registry.sessions() if not s.connected]
+            if method == "DELETE":
+                purged = len(offl)
+                for s in offl:
+                    await ctx.registry.terminate(s, "api-purge-offline")
+                purged += sum(await _cluster_merge(
+                    ctx, M.DATA, {"what": "purge_offlines"},
+                    lambda r: [int(r.get("purged", 0))],
+                ))
+                return 200, {"purged": purged}, J
+            rows = [client_info(s) for s in offl]
+            rows += await _cluster_merge(
+                ctx, M.DATA, {"what": "offlines"},
+                lambda r: r.get("clients", []),
+            )
+            return 200, rows, J
+        if (path.endswith("/online")
+                and len(path) > len("/api/v1/clients/") + len("/online")
+                and path.startswith("/api/v1/clients/")):
+            # liveness incl. cross-node (api.rs clients/{id}/online; the
+            # Online RPC of grpc.rs:506-535); a client literally named
+            # "online" (empty cid here) falls through to the info endpoint
+            cid = path[len("/api/v1/clients/"):-len("/online")]
+            s = ctx.registry.get(cid)
+            online = bool(s and s.connected)
+            if not online:
+                for r in await _cluster_merge(
+                    ctx, M.ONLINE, {"client_id": cid},
+                    lambda r: [r.get("online", False)],
+                ):
+                    online = online or bool(r)
+            return 200, {"clientid": cid, "online": online}, J
         if path.startswith("/api/v1/clients/"):
             cid = path.rsplit("/", 1)[1]
             s = ctx.registry.get(cid)
@@ -266,6 +309,16 @@ class HttpApi:
                 lambda r: r.get("subscriptions", []),
             )
             return 200, rows[: limit], J
+        if path.startswith("/api/v1/subscriptions/"):
+            # one client's subscriptions, cluster-wide (api.rs
+            # subscriptions/{clientid} via SubscriptionsSearch)
+            cid = path[len("/api/v1/subscriptions/"):]
+            rows = subscription_search(ctx, {"clientid": cid})
+            rows += await _cluster_merge(
+                ctx, M.SUBSCRIPTIONS_SEARCH, {"clientid": cid},
+                lambda r: r.get("subscriptions", []),
+            )
+            return 200, rows, J
         if path.startswith("/api/v1/routes/"):
             # routes a publish to this topic would take (api.rs routes/{topic});
             # use the un-rstripped path — trailing slashes are distinct
@@ -285,14 +338,64 @@ class HttpApi:
                 ctx, M.ROUTES_GET, {"limit": limit}, lambda r: r.get("routes", [])
             )
             return 200, rows[: limit], J
+        if path == "/api/v1/stats/sum":
+            # cluster-merged gauge totals (api.rs stats/sum; counter.rs
+            # merge — all our exposed gauges are Sum-mode counts). "nodes"
+            # counts the nodes actually summed, not the configured peers —
+            # a down peer contributes nothing to either number.
+            total = dict(ctx.stats().to_json())
+            replies = await _cluster_merge(
+                ctx, M.STATS_GET, {}, lambda r: [r] if "stats" in r else []
+            )
+            for rec in replies:
+                for k, v in rec.get("stats", {}).items():
+                    if isinstance(v, (int, float)):
+                        total[k] = total.get(k, 0) + v
+            return 200, {"nodes": 1 + len(replies), "stats": total}, J
         if path == "/api/v1/stats":
             nodes = [{"node": ctx.node_id, "stats": ctx.stats().to_json()}]
             nodes += await _cluster_merge(
                 ctx, M.STATS_GET, {}, lambda r: [r] if "stats" in r else []
             )
             return 200, nodes, J
+        if path == "/api/v1/metrics/sum":
+            total = dict(ctx.metrics.to_json())
+            for rec in await _cluster_merge(
+                ctx, M.DATA, {"what": "metrics"},
+                lambda r: [r.get("metrics", {})],
+            ):
+                for k, v in rec.items():
+                    if isinstance(v, (int, float)):
+                        total[k] = total.get(k, 0) + v
+            return 200, {"metrics": total}, J
         if path == "/api/v1/metrics":
             return 200, {"node": ctx.node_id, "metrics": ctx.metrics.to_json()}, J
+        if path.startswith("/api/v1/plugins/"):
+            # single-plugin control (api.rs plugins/{plugin}[/load|/unload|
+            # /config/reload])
+            plugins = getattr(ctx, "plugins", None)
+            if plugins is None:
+                return 404, {"error": "no plugin manager"}, J
+            rest = path[len("/api/v1/plugins/"):]
+            name, _, action = rest.partition("/")
+            p = plugins.get(name)
+            if p is None:
+                return 404, {"error": f"no plugin {name!r}"}, J
+            if action == "" and method == "GET":
+                return 200, next(
+                    d for d in plugins.describe() if d["name"] == name), J
+            if action == "load" and method == "PUT":
+                return 200, {"loaded": await plugins.start(name)}, J
+            if action == "unload" and method == "PUT":
+                return 200, {"unloaded": await plugins.stop(name)}, J
+            if action == "config" and method == "GET":
+                return 200, dict(p.config), J
+            if action == "config/reload" and method == "PUT":
+                if not hasattr(p, "load_config"):
+                    return 501, {"error": "plugin has no config reload"}, J
+                await p.load_config()
+                return 200, {"reloaded": name}, J
+            return 405, {"error": "unsupported plugin action"}, J
         if path == "/api/v1/plugins":
             plugins = getattr(ctx, "plugins", None)
             return 200, (plugins.describe() if plugins else []), J

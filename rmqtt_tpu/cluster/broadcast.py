@@ -176,6 +176,23 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
         return {"clients": [client_info(s) for s in list(ctx.registry.sessions())[:limit]]}
     if mtype == M.STATS_GET:
         return {"node": ctx.node_id, "stats": ctx.stats().to_json()}
+    if mtype == M.DATA:
+        # opaque data channel (grpc.rs Message::Data); carries the admin
+        # API's cluster queries that have no dedicated variant
+        what = (body or {}).get("what")
+        if what == "metrics":
+            return {"metrics": ctx.metrics.to_json()}
+        if what == "offlines":
+            from rmqtt_tpu.broker.http_api import client_info
+
+            return {"clients": [client_info(s) for s in ctx.registry.sessions()
+                                if not s.connected]}
+        if what == "purge_offlines":
+            offl = [s for s in ctx.registry.sessions() if not s.connected]
+            for s in offl:
+                await ctx.registry.terminate(s, "api-purge-offline")
+            return {"purged": len(offl)}
+        return {"data": None}
     if mtype == M.PING:
         return {"pong": True}
     return _UNHANDLED

@@ -86,6 +86,10 @@ class PluginManager:
             return False
         if await p.stop():
             p.active = False
+            # stop() unregisters whatever init() installed (hooks, ctx
+            # seams); a later start() must re-run init or the plugin comes
+            # back hookless (plugin.rs re-inits on load after unload)
+            self._inited.discard(name)
             return True
         return False
 

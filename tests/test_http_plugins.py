@@ -13,29 +13,13 @@ from rmqtt_tpu.broker.server import MqttBroker
 from tests.mqtt_client import TestClient
 
 
-async def http_get(port, path):
-    r, w = await asyncio.open_connection("127.0.0.1", port)
-    w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
-    await w.drain()
-    status = (await r.readline()).split()[1]
-    headers = {}
-    while True:
-        line = await r.readline()
-        if line in (b"\r\n", b""):
-            break
-        k, _, v = line.decode().partition(":")
-        headers[k.lower()] = v.strip()
-    body = await r.readexactly(int(headers["content-length"]))
-    w.close()
-    return int(status), body
-
-
-async def http_post(port, path, obj):
-    payload = json.dumps(obj).encode()
+async def http_req(port, method, path, obj=None, raw=False):
+    """One HTTP round trip; json-decodes the body unless ``raw``."""
+    payload = json.dumps(obj).encode() if obj is not None else b""
     r, w = await asyncio.open_connection("127.0.0.1", port)
     w.write(
-        f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Length: {len(payload)}\r\n\r\n".encode()
-        + payload
+        f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
     )
     await w.drain()
     status = (await r.readline()).split()[1]
@@ -48,7 +32,15 @@ async def http_post(port, path, obj):
         headers[k.lower()] = v.strip()
     body = await r.readexactly(int(headers["content-length"]))
     w.close()
-    return int(status), json.loads(body)
+    return int(status), body if raw else json.loads(body)
+
+
+async def http_get(port, path):
+    return await http_req(port, "GET", path, raw=True)
+
+
+async def http_post(port, path, obj):
+    return await http_req(port, "POST", path, obj)
 
 
 def api_test(fn, plugins=None, **cfg):
@@ -252,3 +244,80 @@ def test_shared_sub_strategies():
     ]
     choice = make_strategy("random", seed=3)
     assert all(choice("g", "t", cands2) == 1 for _ in range(8))
+
+
+@api_test
+async def test_api_extended_routes(broker, api):
+    """Round-4 route-surface parity (api.rs): clients/{id}/online,
+    clients/offlines GET+DELETE, subscriptions/{clientid}, stats/sum,
+    metrics/sum, plugins/{plugin} control."""
+    from rmqtt_tpu.broker.codec import props as P
+
+    c = await TestClient.connect(broker.port, "ext-client", version=pk.V5,
+                                 properties={P.SESSION_EXPIRY_INTERVAL: 300})
+    await c.subscribe("ext/a", qos=1)
+    await c.subscribe("ext/b", qos=0)
+    p = api.bound_port
+    # online check
+    st, body = await http_req(p, "GET", "/api/v1/clients/ext-client/online")
+    assert st == 200 and body["online"] is True
+    st, body = await http_req(p, "GET", "/api/v1/clients/ghost/online")
+    assert st == 200 and body["online"] is False
+    # per-client subscriptions
+    st, body = await http_req(p, "GET", "/api/v1/subscriptions/ext-client")
+    assert st == 200 and sorted(r["topic_filter"] for r in body) == ["ext/a", "ext/b"]
+    # stats/metrics sums (single node: same as local, but numeric)
+    st, body = await http_req(p, "GET", "/api/v1/stats/sum")
+    assert st == 200 and body["stats"]["connections"] == 1
+    st, body = await http_req(p, "GET", "/api/v1/metrics/sum")
+    assert st == 200 and isinstance(body["metrics"], dict)
+    # offline listing + purge
+    await c.disconnect_clean()
+    await asyncio.sleep(0.1)
+    st, body = await http_req(p, "GET", "/api/v1/clients/offlines")
+    assert st == 200 and [r["clientid"] for r in body] == ["ext-client"]
+    st, body = await http_req(p, "DELETE", "/api/v1/clients/offlines")
+    assert st == 200 and body["purged"] == 1
+    st, body = await http_req(p, "GET", "/api/v1/clients/offlines")
+    assert st == 200 and body == []
+
+
+def test_api_plugin_control():
+    from rmqtt_tpu.plugins.sys_topic import SysTopicPlugin
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        b.ctx.plugins.register(SysTopicPlugin(b.ctx))
+        api = HttpApi(b.ctx, port=0)
+        await b.start()
+        await api.start()
+        try:
+            p = api.bound_port
+            st, body = await http_req(p, "GET", "/api/v1/plugins/rmqtt-sys-topic")
+            assert st == 200 and body["name"] == "rmqtt-sys-topic" and body["active"]
+            st, body = await http_req(p, "PUT", "/api/v1/plugins/rmqtt-sys-topic/unload")
+            assert st == 200 and body["unloaded"] is True
+            st, body = await http_req(p, "GET", "/api/v1/plugins/rmqtt-sys-topic")
+            assert not body["active"]
+            st, body = await http_req(p, "PUT", "/api/v1/plugins/rmqtt-sys-topic/load")
+            assert st == 200 and body["loaded"] is True
+            # the reload must RE-INIT: the event hooks installed by init()
+            # were unregistered by stop(), so a fresh client connect still
+            # produces its $SYS event (regression: unload→load came back
+            # hookless because init was skipped for already-inited names)
+            watcher = await TestClient.connect(b.port, "reload-watch")
+            await watcher.subscribe("$SYS/#", qos=0)
+            await TestClient.connect(b.port, "post-reload-client")
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while True:
+                ev = await watcher.recv(timeout=5.0)
+                if ev.topic.endswith("/post-reload-client/connected"):
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+            st, body = await http_req(p, "GET", "/api/v1/plugins/nope")
+            assert st == 404
+        finally:
+            await api.stop()
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
