@@ -10,7 +10,7 @@ connection handler receives — the extension-manager seam
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 from rmqtt_tpu.broker.acl import AclEngine
 from rmqtt_tpu.broker.delayed import DelayedSender
@@ -48,6 +48,12 @@ class BrokerConfig:
     # (server.rs:229); workers peer over the cluster layer for cross-worker
     # delivery (see broker/__main__.py --workers)
     reuse_port: bool = False
+    # additional NAMED listeners (reference rmqtt-conf/src/listener.rs:
+    # [listener.tcp.<name>] / ws / tls / wss sub-tables, each its own
+    # address and TLS material): dicts with keys
+    # {name, kind: tcp|ws|tls|wss, host?, port, tls_cert?, tls_key?,
+    #  tls_client_ca?} — the flat fields above stay the primary listener
+    extra_listeners: List[Dict[str, Any]] = field(default_factory=list)
     node_id: int = 1
     router: str = "trie"  # "trie" (DefaultRouter) | "xla" (TPU)
     allow_anonymous: bool = True

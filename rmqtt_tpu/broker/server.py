@@ -43,6 +43,20 @@ log = logging.getLogger("rmqtt_tpu.broker")
 _UNSET = object()  # sentinel: _on_connection called as the raw listener callback
 
 
+def _build_ssl_context(cert: str, key, client_ca: str = ""):
+    """Server-side TLS context; with ``client_ca`` set, mutual TLS
+    (builder.rs tls_cross_certificate): require and verify client certs —
+    metadata lands in ConnectInfo."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key or None)
+    if client_ca:
+        ctx.load_verify_locations(client_ca)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
 def extract_cert_info(writer):
     """TLS client-certificate metadata from the connection, if any
     (cert_extractor.rs semantics over stdlib ssl: populated only when the
@@ -79,6 +93,8 @@ class MqttBroker:
         self._tls_server: Optional[asyncio.base_events.Server] = None
         self._wss_server: Optional[asyncio.base_events.Server] = None
         self._quic_server = None  # QuicServerHandle (broker/quic.py)
+        # named extra listeners (listener.rs sub-tables): name → Server
+        self._extra_servers: dict = {}
 
     def _bound(self, srv) -> int:
         return srv.sockets[0].getsockname()[1]
@@ -99,6 +115,10 @@ class MqttBroker:
     def port(self) -> int:
         return self._server.sockets[0].getsockname()[1]
 
+    def extra_port(self, name: str) -> int:
+        """Bound port of a named extra listener."""
+        return self._bound(self._extra_servers[name])
+
     async def start(self) -> None:
         await self.ctx.hooks.fire(HookType.BEFORE_STARTUP)
         self.ctx.start()
@@ -115,15 +135,7 @@ class MqttBroker:
                 raise ValueError(
                     "listener.tls_port/wss_port configured without listener.tls_cert"
                 )
-            import ssl
-
-            sslctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            sslctx.load_cert_chain(cfg.tls_cert, cfg.tls_key or None)
-            if cfg.tls_client_ca:
-                # mutual TLS (builder.rs tls_cross_certificate): require and
-                # verify client certs; metadata lands in ConnectInfo
-                sslctx.load_verify_locations(cfg.tls_client_ca)
-                sslctx.verify_mode = ssl.CERT_REQUIRED
+            sslctx = _build_ssl_context(cfg.tls_cert, cfg.tls_key, cfg.tls_client_ca)
         if cfg.ws_port is not None:
             self._ws_server = await asyncio.start_server(
                 self._on_ws_connection, cfg.host, cfg.ws_port, **rp
@@ -150,6 +162,35 @@ class MqttBroker:
             )
             log.info("quic listening on %s:%s", cfg.host,
                      self._quic_server.bound_port)
+        # named extra listeners (reference [listener.tcp.<name>] blocks,
+        # rmqtt-conf/src/listener.rs): each its own addr + TLS material
+        for spec in cfg.extra_listeners:
+            kind = spec.get("kind", "tcp")
+            name = spec.get("name", f"{kind}:{spec.get('port')}")
+            if name in self._extra_servers:
+                raise ValueError(f"duplicate listener name {name!r}")
+            handler = (self._on_ws_connection if kind in ("ws", "wss")
+                       else self._on_connection)
+            lss = None
+            if kind in ("tls", "wss"):
+                # cert+key fall back from the global listener AS A PAIR —
+                # a per-listener cert must never pair with the global key
+                if spec.get("tls_cert"):
+                    cert, ckey = spec["tls_cert"], spec.get("tls_key")
+                else:
+                    cert, ckey = cfg.tls_cert, cfg.tls_key
+                if not cert:
+                    raise ValueError(f"listener {name!r}: tls without a cert")
+                lss = _build_ssl_context(
+                    cert, ckey, spec.get("tls_client_ca") or cfg.tls_client_ca
+                )
+            srv = await asyncio.start_server(
+                handler, spec.get("host", cfg.host), int(spec["port"]),
+                ssl=lss, **rp,
+            )
+            self._extra_servers[name] = srv
+            log.info("%s listener %r on %s:%s", kind, name,
+                     spec.get("host", cfg.host), self._bound(srv))
 
     async def stop(self) -> None:
         # close sessions BEFORE wait_closed(): in py3.12 Server.wait_closed
@@ -158,7 +199,8 @@ class MqttBroker:
         for session in self.ctx.registry.sessions():
             if session.state is not None:
                 await session.state.close()
-        for srv in (self._server, self._ws_server, self._tls_server, self._wss_server):
+        for srv in (self._server, self._ws_server, self._tls_server, self._wss_server,
+                    *self._extra_servers.values()):
             if srv is not None:
                 srv.close()
                 await srv.wait_closed()

@@ -118,6 +118,40 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "router": node.get("router", "trie"),
         "fitter": fitter,
     }
+    # reference-style named sub-listeners ([listener.tcp.external] etc.,
+    # rmqtt-conf/src/listener.rs) → BrokerConfig.extra_listeners; the flat
+    # [listener] keys above stay the primary listener
+    extra_listeners = []
+    for kind in ("tcp", "ws", "tls", "wss"):
+        sub = listener.get(kind)
+        if not isinstance(sub, dict):
+            continue
+        for lname, spec in sub.items():
+            if not isinstance(spec, dict):
+                raise ValueError(
+                    f"listener.{kind}.{lname}: sub-listeners are NAMED "
+                    f"tables ([listener.{kind}.<name>] with a port); for a "
+                    f"single listener use the flat [listener] keys"
+                )
+            if "port" not in spec:
+                raise ValueError(f"listener.{kind}.{lname} needs a 'port'")
+            if kind in ("tcp", "ws") and (
+                spec.get("tls_cert") or spec.get("tls_key")
+            ):
+                raise ValueError(
+                    f"listener.{kind}.{lname}: tls_cert/tls_key on a "
+                    f"plaintext {kind!r} listener (use kind "
+                    f"{'wss' if kind == 'ws' else 'tls'})"
+                )
+            extra_listeners.append({
+                "kind": kind, "name": f"{kind}.{lname}",
+                **{k: v for k, v in spec.items()
+                   if k in ("host", "port", "tls_cert", "tls_key",
+                            "tls_client_ca")},
+            })
+    if extra_listeners:
+        broker_kwargs["extra_listeners"] = extra_listeners
+
     broker_fields = {f.name for f in fields(BrokerConfig)}
     for k, v in {**mqtt, **retain}.items():
         if k in broker_fields:

@@ -405,3 +405,98 @@ def test_quic_seam():
             quic_mod._backend = None
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_named_extra_listeners(certs):
+    """Named per-listener blocks (reference [listener.tcp.<name>] /
+    listener.rs sub-tables): one broker serves its primary port plus named
+    tcp/ws/tls listeners, each with its own address and TLS material, all
+    feeding the same session registry."""
+    import ssl as _ssl
+
+    cert, key = certs
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, extra_listeners=[
+            {"kind": "tcp", "name": "tcp.internal", "port": 0},
+            {"kind": "ws", "name": "ws.external", "port": 0},
+            {"kind": "tls", "name": "tls.external", "port": 0,
+             "tls_cert": cert, "tls_key": key},
+        ])))
+        await b.start()
+        try:
+            # tcp.internal
+            sub = await TestClient.connect(b.extra_port("tcp.internal"), "ml-sub")
+            await sub.subscribe("ml/#", qos=1)
+            # primary listener
+            pub = await TestClient.connect(b.port, "ml-pub")
+            await pub.publish("ml/t", b"cross-listener", qos=1)
+            p = await sub.recv()
+            assert p.payload == b"cross-listener"
+            # tls.external with its per-listener cert
+            sslctx = _ssl.create_default_context()
+            sslctx.check_hostname = False
+            sslctx.verify_mode = _ssl.CERT_NONE
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", b.extra_port("tls.external"), ssl=sslctx)
+            codec = MqttCodec()
+            w.write(codec.encode(pk.Connect(client_id="ml-tls")))
+            await w.drain()
+            while True:
+                pkts = codec.feed(await r.read(256))
+                if pkts:
+                    assert isinstance(pkts[0], pk.Connack)
+                    assert pkts[0].reason_code == 0
+                    break
+            w.close()
+        finally:
+            await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_conf_parses_named_listeners(tmp_path):
+    cfgf = tmp_path / "ml.toml"
+    cfgf.write_text(
+        "[listener]\nport = 1883\n"
+        "[listener.tcp.internal]\nport = 11884\nhost = \"127.0.0.1\"\n"
+        "[listener.ws.external]\nport = 18080\n"
+    )
+    from rmqtt_tpu import conf
+
+    s = conf.load(str(cfgf))
+    specs = {l["name"]: l for l in s.broker.extra_listeners}
+    assert specs["tcp.internal"]["port"] == 11884
+    assert specs["tcp.internal"]["host"] == "127.0.0.1"
+    assert specs["ws.external"]["kind"] == "ws"
+    assert s.broker.port == 1883
+
+
+def test_named_listener_config_errors(tmp_path):
+    import pytest as _pytest
+
+    from rmqtt_tpu import conf
+
+    bad1 = tmp_path / "b1.toml"
+    bad1.write_text("[listener.tcp]\nport = 1884\n")
+    with _pytest.raises(ValueError, match="NAMED tables"):
+        conf.load(str(bad1))
+    bad2 = tmp_path / "b2.toml"
+    bad2.write_text("[listener.ws.ext]\nport = 8080\ntls_cert = \"x.pem\"\n")
+    with _pytest.raises(ValueError, match="plaintext"):
+        conf.load(str(bad2))
+
+    async def dup():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, extra_listeners=[
+            {"kind": "tcp", "name": "same", "port": 0},
+            {"kind": "tcp", "name": "same", "port": 0},
+        ])))
+        try:
+            await b.start()
+            raise AssertionError("duplicate listener name accepted")
+        except ValueError:
+            pass
+        finally:
+            await b.stop()
+
+    asyncio.run(dup())
