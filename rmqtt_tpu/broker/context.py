@@ -205,4 +205,7 @@ class ServerContext:
             s.message_queues += len(sess.deliver_queue)
             s.out_inflights += len(sess.out_inflight)
             s.in_inflights += len(sess.in_qos2)
+        # routing-service gauges (per-exec stats parity, context.rs:506-555)
+        for k, v in self.routing.stats().items():
+            setattr(s, k, v)
         return s

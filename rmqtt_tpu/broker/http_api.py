@@ -351,7 +351,13 @@ class HttpApi:
                 for k, v in rec.get("stats", {}).items():
                     if isinstance(v, (int, float)):
                         total[k] = total.get(k, 0) + v
-            return 200, {"nodes": 1 + len(replies), "stats": total}, J
+            nodes = 1 + len(replies)
+            # *_ema gauges are average-mode (counter.rs StatsMergeMode::Avg),
+            # not summable counts
+            for k in list(total):
+                if k.endswith("_ema") and nodes > 1:
+                    total[k] = round(total[k] / nodes, 1)
+            return 200, {"nodes": nodes, "stats": total}, J
         if path == "/api/v1/stats":
             nodes = [{"node": ctx.node_id, "stats": ctx.stats().to_json()}]
             nodes += await _cluster_merge(

@@ -49,6 +49,24 @@ class RoutingService:
         self._pipe_sem: Optional[asyncio.Semaphore] = None  # built in start()
         self._completion_q: asyncio.Queue = asyncio.Queue()
         self._completer: Optional[asyncio.Task] = None
+        # observability (TaskExecStats analogue, context.rs:506-555):
+        # dispatch counts + an EMA of batch size, surfaced via ctx.stats()
+        self.dispatches = 0
+        self.dispatched_items = 0
+        self.batch_size_ema = 0.0
+        self.inflight = 0  # batches currently past collect, not yet resolved
+
+    def stats(self) -> dict:
+        """Gauges for the admin surface (per-exec stats parity). The _ema
+        key is average-mode for cluster merging (counter.rs AVG), not a
+        summable count — /stats/sum treats the suffix accordingly."""
+        return {
+            "routing_queued": self._q.qsize(),
+            "routing_inflight_batches": self.inflight,
+            "routing_dispatches": self.dispatches,
+            "routing_dispatched_items": self.dispatched_items,
+            "routing_batch_size_ema": round(self.batch_size_ema, 1),
+        }
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -149,6 +167,12 @@ class RoutingService:
 
     async def _dispatch_one(self, loop, batch, inline_ok, pipelined) -> None:
         items = [(fid, topic) for fid, topic, _, _ in batch]
+        self.dispatches += 1
+        self.dispatched_items += len(items)
+        self.batch_size_ema = (
+            len(items) if self.dispatches == 1
+            else 0.9 * self.batch_size_ema + 0.1 * len(items)
+        )
         if inline_ok(len(items)):
             try:
                 self._resolve(batch, self.router.matches_batch_raw(items))
@@ -159,26 +183,31 @@ class RoutingService:
             # in-flight bound: block BEFORE submitting so at most
             # pipeline_depth batches are ever past submit
             await self._pipe_sem.acquire()
+            self.inflight += 1
             try:
                 done, payload = await loop.run_in_executor(
                     None, self.router.submit_batch_raw, items
                 )
             except Exception as e:
+                self.inflight -= 1
                 self._pipe_sem.release()
                 self._reject(batch, e)
                 return
             except asyncio.CancelledError:
+                self.inflight -= 1
                 self._pipe_sem.release()
                 raise
             if done:
                 # the router resolved synchronously (e.g. the hybrid served
                 # it from the host trie): don't spend a pipeline permit or
                 # a completion-queue round trip on it
+                self.inflight -= 1
                 self._pipe_sem.release()
                 self._resolve(batch, payload)
                 return
             await self._completion_q.put((batch, payload))
             return
+        self.inflight += 1
         try:
             results = await loop.run_in_executor(
                 None, self.router.matches_batch_raw, items
@@ -186,6 +215,8 @@ class RoutingService:
         except Exception as e:  # resolve all waiters with the error
             self._reject(batch, e)
             return
+        finally:
+            self.inflight -= 1
         self._resolve(batch, results)
 
     async def _complete_loop(self) -> None:
@@ -205,4 +236,5 @@ class RoutingService:
             else:
                 self._resolve(batch, results)
             finally:
+                self.inflight -= 1
                 self._pipe_sem.release()

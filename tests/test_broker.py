@@ -516,3 +516,21 @@ def test_handshake_rate_gate():
             await b.stop()
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+@broker_test
+async def test_routing_service_stats_surface(broker):
+    """The routing service's dispatch gauges reach /stats (per-exec stats
+    parity with the reference's TaskExecStats, context.rs:506-555)."""
+    sub = await connect(broker, "rstat-sub")
+    await sub.subscribe("rs/#", qos=1)
+    pub = await connect(broker, "rstat-pub")
+    for i in range(5):
+        await pub.publish("rs/t", str(i).encode(), qos=1)
+    for _ in range(5):
+        await sub.recv()
+    st = broker.ctx.stats().to_json()
+    assert st["routing_dispatches"] >= 5
+    assert st["routing_dispatched_items"] >= 5
+    assert st["routing_batch_size_ema"] >= 1
+    assert "routing_queued" in st and "routing_inflight_batches" in st
