@@ -73,3 +73,60 @@ def test_two_workers_share_port_and_deliver_across():
             proc.wait(timeout=15)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+@pytest.mark.timeout(120)
+def test_workers_with_xla_router():
+    """The full deployment combo: SO_REUSEPORT workers each running the
+    XlaRouter (adaptive hybrid + pipelined RoutingService), cross-worker
+    delivery through the localhost broadcast peering."""
+    port = 18871
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(port),
+         "--workers", "2", "--router", "xla",
+         "--cluster-port-base", str(port + 500)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(240):
+            try:
+                _connect(port, b"probe").close()
+                break
+            except OSError:
+                time.sleep(0.25)
+        else:
+            pytest.fail("xla workers never came up")
+        time.sleep(1.5)
+        subs = []
+        for i in range(8):
+            s = _connect(port, b"xs%d" % i)
+            # pid 1, filter "xla/#", qos 0
+            s.sendall(_pkt(0x82, b"\x00\x01" + b"\x00\x05xla/#" + b"\x00"))
+            assert s.recv(5)[0] == 0x90
+            s.settimeout(8)
+            subs.append(s)
+        pubs = [_connect(port, b"xp%d" % i) for i in range(4)]
+        t = b"xla/t"
+        for i, p in enumerate(pubs):
+            p.sendall(_pkt(0x30, len(t).to_bytes(2, "big") + t + b"m%d" % i))
+        got = 0
+        for s in subs:
+            buf = b""
+            deadline = time.time() + 10
+            while buf.count(t) < len(pubs) and time.time() < deadline:
+                try:
+                    buf += s.recv(4096)
+                except socket.timeout:
+                    break
+            got += buf.count(t)
+        assert got == len(subs) * len(pubs), f"only {got} xla deliveries"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
