@@ -66,6 +66,10 @@ class BrokerConfig:
     max_qos: int = 2
     retain_enable: bool = True
     retain_max: int = 1_000_000
+    # switch retained wildcard lookups to the partitioned TPU inverse-match
+    # kernel (ops/retained_part) once the store exceeds the threshold
+    retain_tpu: bool = False
+    retain_tpu_threshold: int = 50_000
     delayed_publish_max: int = 100_000
     shared_subscription: bool = True
     limit_subscription: bool = False  # enable $limit/$exclusive prefixes
@@ -121,7 +125,12 @@ class ServerContext:
         self.routing = RoutingService(
             router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
         )
-        self.retain = RetainStore(enable=self.cfg.retain_enable, max_retained=self.cfg.retain_max)
+        self.retain = RetainStore(
+            enable=self.cfg.retain_enable,
+            max_retained=self.cfg.retain_max,
+            tpu=self.cfg.retain_tpu,
+            tpu_threshold=self.cfg.retain_tpu_threshold,
+        )
         # MessageManager seam (message.rs:61-147): the message-storage
         # plugin installs itself here; None = storage disabled (the
         # reference's DefaultMessageManager no-op, message.rs:148-164)

@@ -4,16 +4,17 @@ Mirrors the reference's `RetainStorage` trait + in-memory default
 (`/root/reference/rmqtt/src/retain.rs:100-213`): set (empty payload clears,
 MQTT-3.3.1-10/11), wildcard lookup on SUBSCRIBE, per-message expiry, count
 and max limits. Backed by the CPU ``RetainTree``; when the store grows past
-``tpu_threshold`` the wildcard lookup switches to the TPU inverse-match
-kernel (`rmqtt_tpu.ops.retained`) over a mirrored row table — the same
-automaton the router uses, per the north star.
+``tpu_threshold`` the wildcard lookup switches to the partitioned TPU
+inverse-match kernel (`rmqtt_tpu.ops.retained_part`) over a mirrored
+chunk-tiled row table — the same pruned automaton the router uses, per the
+north star.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-from rmqtt_tpu.core.topic import filter_valid
+from rmqtt_tpu.core.topic import filter_valid, topic_valid
 from rmqtt_tpu.core.trie import RetainTree
 from rmqtt_tpu.broker.types import Message, now
 
@@ -33,7 +34,7 @@ class RetainStore:
         self._tree: RetainTree[Message] = RetainTree()
         self._tpu = tpu
         self._tpu_threshold = tpu_threshold
-        self._table = None  # lazily-built ops.encode.FilterTable mirror
+        self._table = None  # lazily-built ops.retained_part.RetainedTable mirror
         self._scanner = None
         self._rowid_by_topic: Dict[str, int] = {}
         self._msg_by_rowid: Dict[int, Tuple[str, Message]] = {}
@@ -54,6 +55,12 @@ class RetainStore:
     def set_local(self, topic: str, msg: Message) -> bool:
         """Like `set` but without the cluster broadcast (inbound sync path)."""
         if not self.enable:
+            return False
+        if not topic_valid(topic):
+            # a wildcard/invalid publish topic (reachable via the HTTP API,
+            # which skips the wire codec's validation) must be refused, not
+            # half-inserted: the TPU mirror rejects wildcard rows and the
+            # tree would diverge from it permanently
             return False
         if not msg.payload:  # empty payload clears (MQTT-3.3.1-10)
             self.remove_local(topic)
@@ -111,21 +118,33 @@ class RetainStore:
     # ---- TPU mirror -------------------------------------------------------
     def _ensure_tpu(self):
         if self._scanner is None:
-            from rmqtt_tpu.ops.encode import FilterTable
-            from rmqtt_tpu.ops.retained import RetainedScanner
+            from rmqtt_tpu.ops.retained_part import (
+                PartitionedRetainedScanner,
+                RetainedTable,
+            )
+            from rmqtt_tpu.utils.tpuprobe import ensure_safe_platform
 
-            self._table = FilterTable()
-            self._scanner = RetainedScanner(self._table)
+            # the first scan is the first backend touch on this path: a
+            # wedged accelerator grant would block the event loop forever
+            ensure_safe_platform()
+            self._table = RetainedTable()
+            self._scanner = PartitionedRetainedScanner(self._table)
             # backfill current tree contents (incl. $-topics)
             for levels, msg in self._tree.items():
-                self._set_row("/".join(levels), msg, backfill_only=True)
+                self._set_row("/".join(levels), msg)
 
-    def _set_row(self, topic: str, msg: Message, backfill_only: bool = False) -> None:
-        if self._scanner is None and not backfill_only:
+    def _set_row(self, topic: str, msg: Message) -> None:
+        if self._scanner is None:
             return  # rows are built lazily on first TPU lookup
         rid = self._rowid_by_topic.get(topic)
         if rid is None:
-            rid = self._table.add(topic)
+            try:
+                rid = self._table.add(topic)
+            except ValueError:
+                # pre-existing invalid tree entry (e.g. loaded from an old
+                # persisted store): leave it to the tree path rather than
+                # poisoning every future scan
+                return
             self._rowid_by_topic[topic] = rid
         self._msg_by_rowid[rid] = (topic, msg)
 

@@ -161,6 +161,10 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
             broker_kwargs["retain_enable"] = bool(retain["enable"])
         if "max_retained" in retain:
             broker_kwargs["retain_max"] = int(retain["max_retained"])
+        if "tpu" in retain:
+            broker_kwargs["retain_tpu"] = bool(retain["tpu"])
+        if "tpu_threshold" in retain:
+            broker_kwargs["retain_tpu_threshold"] = int(retain["tpu_threshold"])
 
     cluster_listen = None
     raft_db = None

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Round-long TPU chip hunter (VERDICT r4 item 1).
+
+Rounds 3 and 4 produced ZERO on-chip numbers because the driver bench
+probes once at round end and the chip happened to be down both times.
+This process inverts that: it runs for the whole round, polls the chip on
+an interval via the safe subprocess probe (rmqtt_tpu/utils/tpuprobe.py —
+an in-process ``jax.devices()`` can block forever on a wedged grant), and
+the moment the chip answers it:
+
+  1. runs ``scripts/chip_smoke.py`` (pass/fail map of every device path),
+  2. runs ``bench.py --config N`` for N=1..5 as SEPARATE subprocesses,
+     checkpointing each config's JSON to ``.chip_hunt/cfgN.json`` the
+     instant it completes — a 10-minute chip window yields cfg1+cfg2 data
+     even if cfg3 wedges the grant,
+  3. merges every checkpoint into ``BENCH_LAST_TPU.json`` (the snapshot
+     ``bench.py`` attaches to a CPU-fallback driver run), so whatever the
+     chip state is at round end, the hunter's numbers reach the artifact.
+
+Once all five configs have on-chip results it runs a phase-2 list
+(profiled cfg3 for the roofline, cfg4 re-run) and then drops to a slow
+heartbeat. Every attempt is logged to ``CHIP_HUNT_r05.log`` with a
+timestamp — if the chip stays down all round, the log is the proof of
+continuous effort the judge asked for.
+
+Usage:  nohup python scripts/chip_hunter.py >/dev/null 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+HUNT_DIR = REPO / ".chip_hunt"
+LOG_PATH = REPO / "CHIP_HUNT_r05.log"
+LAST_TPU = REPO / "BENCH_LAST_TPU.json"
+STATE_PATH = HUNT_DIR / "state.json"
+
+PROBE_TIMEOUT = 75.0
+PROBE_INTERVAL = 240.0        # between probes while the chip is down
+HEARTBEAT_INTERVAL = 900.0    # after everything has completed
+MAX_HOURS = 11.5
+
+# per-config subprocess deadlines (seconds). cfg4/cfg5 build 10M-filter
+# tables (minutes of host work) before the first device touch.
+CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200}
+SMOKE_TIMEOUT = 1200
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def load_state() -> dict:
+    try:
+        return json.loads(STATE_PATH.read_text())
+    except Exception:
+        return {"done_configs": [], "failed": {}, "smoke_ok": False,
+                "phase2_done": [], "probes": 0, "windows": 0}
+
+
+def save_state(st: dict) -> None:
+    HUNT_DIR.mkdir(exist_ok=True)
+    STATE_PATH.write_text(json.dumps(st, indent=1))
+
+
+def run_sub(cmd: list[str], timeout: float) -> tuple[int, str, str]:
+    """Run a child in its own process group so a wedged device fetch can be
+    killed together with any grandchildren it spawned."""
+    try:
+        p = subprocess.Popen(
+            cmd, cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, start_new_session=True,
+        )
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except Exception:
+            pass
+        out, err = p.communicate()
+        return -9, out or "", (err or "") + f"\n[hunter] killed after {timeout}s"
+
+
+def merge_snapshot(st: dict) -> None:
+    """Fold every per-config checkpoint into BENCH_LAST_TPU.json.
+
+    bench.py's _persist_last_tpu also merge-writes this file on an on-chip
+    run; the hunter re-merges after each config so a kill at any point
+    leaves the union of everything measured so far."""
+    configs: dict = {}
+    try:
+        prior = json.loads(LAST_TPU.read_text())
+        configs.update(prior.get("configs") or {})
+    except Exception:
+        pass
+    for n in range(1, 6):
+        ck = HUNT_DIR / f"cfg{n}.json"
+        if not ck.exists():
+            continue
+        try:
+            one = json.loads(ck.read_text())
+            configs.update(one.get("configs") or {})
+        except Exception as e:
+            log(f"checkpoint cfg{n} unreadable: {e}")
+    if not configs:
+        return
+    # headline = largest config present (same order bench.py uses)
+    for head in ("cfg4_shared_10m_zipf", "cfg5_retained_10m", "cfg3_mixed_1m",
+                 "cfg2_plus_100k", "cfg1_exact_1k"):
+        if head in configs:
+            break
+    h = configs[head]
+    value = h.get("router_topics_per_sec") or h.get("tpu_topics_per_sec")
+    vsb = h.get("router_speedup") or h.get("speedup")
+    snap = {
+        "metric": f"publish_route_topics_per_sec[{head}]",
+        "value": value,
+        "unit": "topics/s",
+        "vs_baseline": vsb,
+        "configs": configs,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "source": "round-5 chip hunter (per-config checkpoints)",
+    }
+    if st["failed"]:
+        snap["failed_configs"] = st["failed"]
+    LAST_TPU.write_text(json.dumps(snap, indent=1))
+    log(f"merged snapshot → BENCH_LAST_TPU.json ({sorted(configs)})")
+
+
+def probe() -> int:
+    from rmqtt_tpu.utils.tpuprobe import probe_device_count
+
+    return probe_device_count(timeout=PROBE_TIMEOUT, retries=1)
+
+
+def chip_window(st: dict) -> None:
+    """The chip answered — extract as much as possible before it wedges."""
+    st["windows"] += 1
+    save_state(st)
+    if not st["smoke_ok"]:
+        log("chip up → running chip_smoke")
+        rc, out, err = run_sub([sys.executable, "scripts/chip_smoke.py"],
+                               SMOKE_TIMEOUT)
+        tail = (out or err).strip().splitlines()[-1:] or [""]
+        log(f"chip_smoke rc={rc}: {tail[0][:200]}")
+        if rc == 0:
+            st["smoke_ok"] = True
+            save_state(st)
+        elif rc == 2:
+            return  # chip vanished between probe and smoke
+        # rc==1 (some step failed): still try the bench — the failing step
+        # may be an optional path; the bench latches working variants
+
+    for n in range(1, 6):
+        if n in st["done_configs"]:
+            continue
+        log(f"bench --config {n} starting (timeout {CONFIG_TIMEOUT[n]}s)")
+        t0 = time.time()
+        rc, out, err = run_sub(
+            [sys.executable, "bench.py", "--config", str(n)],
+            CONFIG_TIMEOUT[n])
+        took = round(time.time() - t0, 1)
+        json_line = None
+        for line in (out or "").strip().splitlines()[::-1]:
+            if line.startswith("{"):
+                json_line = line
+                break
+        if rc == 0 and json_line:
+            parsed = json.loads(json_line)
+            if parsed.get("platform") == "tpu":
+                (HUNT_DIR / f"cfg{n}.json").write_text(json_line)
+                st["done_configs"].append(n)
+                st["failed"].pop(str(n), None)
+                log(f"cfg{n} ON-CHIP ok in {took}s: value={parsed.get('value')} "
+                    f"vs_baseline={parsed.get('vs_baseline')}")
+                save_state(st)
+                merge_snapshot(st)
+                continue
+            log(f"cfg{n} ran on platform={parsed.get('platform')} (chip lost "
+                f"mid-window?) — not checkpointing")
+            return
+        err_tail = (err or "").strip().splitlines()[-3:]
+        st["failed"][str(n)] = {"rc": rc, "took_s": took,
+                                "err": " | ".join(err_tail)[-500:]}
+        save_state(st)
+        log(f"cfg{n} FAILED rc={rc} after {took}s: {' | '.join(err_tail)[:300]}")
+        # a failure may mean the grant wedged: re-probe before burning the
+        # next config's table build on a dead chip
+        if probe() == 0:
+            log("chip unreachable after failure — back to hunting")
+            return
+
+    # phase 2: everything measured once → spend the window on the roofline
+    # profile (VERDICT item 2) and a stream-sweep rerun at cfg3
+    phase2 = [
+        ("profile_cfg3", [sys.executable, "bench.py", "--config", "3",
+                          "--profile", str(HUNT_DIR / "xprof")], 4200),
+        ("profile_cfg4", [sys.executable, "bench.py", "--config", "4",
+                          "--profile", str(HUNT_DIR / "xprof")], 7200),
+    ]
+    if len(st["done_configs"]) == 5:
+        for name, cmd, tmo in phase2:
+            if name in st["phase2_done"]:
+                continue
+            log(f"phase2 {name} starting")
+            rc, out, err = run_sub(cmd, tmo)
+            log(f"phase2 {name} rc={rc}")
+            if rc == 0:
+                st["phase2_done"].append(name)
+                save_state(st)
+            else:
+                return
+
+
+def main() -> None:
+    HUNT_DIR.mkdir(exist_ok=True)
+    st = load_state()
+    (HUNT_DIR / "hunter.pid").write_text(str(os.getpid()))
+    log(f"hunter started pid={os.getpid()} (done={st['done_configs']}, "
+        f"smoke_ok={st['smoke_ok']})")
+    deadline = time.time() + MAX_HOURS * 3600
+    while time.time() < deadline:
+        st["probes"] += 1
+        save_state(st)
+        n = probe()
+        if n > 0:
+            log(f"probe #{st['probes']}: {n} device(s) — chip is UP")
+            try:
+                chip_window(st)
+            except Exception as e:
+                log(f"chip window crashed: {type(e).__name__}: {e}")
+            merge_snapshot(st)
+        else:
+            log(f"probe #{st['probes']}: unreachable")
+        done = len(st["done_configs"]) == 5 and len(st["phase2_done"]) >= 2
+        time.sleep(HEARTBEAT_INTERVAL if done else PROBE_INTERVAL)
+    log(f"hunter exiting after {MAX_HOURS}h "
+        f"(probes={st['probes']}, windows={st['windows']}, "
+        f"done_configs={st['done_configs']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -207,6 +207,25 @@ def s_retained():
     assert len(rows) == 3 and len(rows[0].tolist()) >= len(set(topics)) - 1
 
 
+@step("retained_partitioned")
+def s_retained_part():
+    from rmqtt_tpu.core.topic import match_filter
+    from rmqtt_tpu.ops.retained_part import PartitionedRetainedScanner, RetainedTable
+
+    rt = RetainedTable()
+    fids = {}
+    for t in TOPICS[:2000]:
+        if t not in fids.values():
+            fids[rt.add(t)] = t
+    scanner = PartitionedRetainedScanner(rt)
+    filters = ["#", "v0_1/#", "+/+", "v0_2/v1_3/+/#", "+/v1_5/#"]
+    rows = scanner.scan(filters)
+    for f, row in zip(filters, rows):
+        want = sorted(fid for fid, t in fids.items() if match_filter(f, t))
+        assert sorted(row.tolist()) == want, f"mismatch on {f!r}"
+    return {"nchunks": rt.nchunks}
+
+
 @step("stream_pipeline")
 def s_stream():
     from rmqtt_tpu.ops.partitioned import PartitionedMatcher, PartitionedTable
@@ -300,7 +319,7 @@ def main() -> int:
     globals()["ORACLE"] = _oracle(FILTERS)
 
     for fn in (s_partitioned, s_dense, s_ncsplit, s_segmented, s_pallas,
-               s_retained, s_stream, s_hybrid):
+               s_retained, s_retained_part, s_stream, s_hybrid):
         fn()
 
     out = {"platform": platform, "devices": n,

@@ -1,0 +1,201 @@
+"""Partitioned retained-scan (ops/retained_part.py) vs the trie oracle.
+
+Mirrors tests/test_match.py's dense-scanner differential, plus the
+partition-specific machinery: inverse masked index, narrow/broad tier
+split, churn + compaction, $-isolation, deep/hostile filters.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from rmqtt_tpu.core.topic import filter_valid, match_filter
+from rmqtt_tpu.ops.retained_part import (
+    PartitionedRetainedScanner,
+    RetainedTable,
+    filter_masks,
+)
+
+
+def _scan_expect(rows: dict, f: str):
+    return sorted(fid for fid, t in rows.items() if match_filter(f, t))
+
+
+def _rand_store(rng, n=1500):
+    table = RetainedTable()
+    rows = {}
+    words = ["a", "b", "c", "", "$s", "$SYS"]
+    seen = set()
+    while len(rows) < n:
+        k = rng.randint(1, 6)
+        levels = [rng.choice(words) for _ in range(k)]
+        levels = [lev if (i == 0 or not lev.startswith("$")) else "p"
+                  for i, lev in enumerate(levels)]
+        t = "/".join(levels)
+        if t not in seen:
+            seen.add(t)
+            rows[table.add(t)] = t
+    return table, rows
+
+
+def _rand_filters(rng, n=150):
+    filters = []
+    while len(filters) < n:
+        k = rng.randint(1, 6)
+        levels = [rng.choice(["a", "b", "c", "", "+", "$s", "$SYS"]) for _ in range(k)]
+        if rng.random() < 0.4:
+            levels[-1] = "#"
+        f = "/".join(levels)
+        if filter_valid(f):
+            filters.append(f)
+    return filters
+
+
+def test_partitioned_retained_differential():
+    rng = random.Random(29)
+    table, rows = _rand_store(rng)
+    scanner = PartitionedRetainedScanner(table)
+    filters = _rand_filters(rng)
+    got = scanner.scan(filters)
+    for f, matched in zip(filters, got):
+        assert sorted(matched.tolist()) == _scan_expect(rows, f), f"filter={f!r}"
+
+
+def test_partitioned_retained_tier_split():
+    """A batch mixing a bare '#' (broad) with narrow prefix filters must
+    split tiers and still agree with the oracle on both."""
+    rng = random.Random(31)
+    # a big-enough store that '#' lands in the broad tier while prefix
+    # filters stay narrow (shared-chunk packing keeps small stores in a
+    # handful of chunks where everything is one tier)
+    table = RetainedTable()
+    rows = {}
+    for i in range(8000):
+        t = f"d{i % 40}/m{i % 211}/s{i}"
+        rows[table.add(t)] = t
+    scanner = PartitionedRetainedScanner(table)
+    filters = ["#", "d1/m1/+", "d2/+/#", "+/#", "d3/m3/s3", "$SYS/#"]
+    got = scanner.scan(filters)
+    for f, matched in zip(filters, got):
+        assert sorted(matched.tolist()) == _scan_expect(rows, f), f"filter={f!r}"
+    broad_floor = max(16, int(table.nchunks * scanner.BROAD_FRAC))
+    assert len(table.candidates_for_filter("#")) > broad_floor
+    assert len(table.candidates_for_filter("d1/m1/+")) <= broad_floor
+
+
+def test_partitioned_retained_pipelined():
+    rng = random.Random(37)
+    table, rows = _rand_store(rng, n=800)
+    scanner = PartitionedRetainedScanner(table)
+    batches = [_rand_filters(rng, 24) for _ in range(4)]
+    handles = [scanner.scan_submit(b) for b in batches]
+    for fs, h in zip(batches, handles):
+        got = scanner.scan_complete(h)
+        for f, matched in zip(fs, got):
+            assert sorted(matched.tolist()) == _scan_expect(rows, f)
+
+
+def test_partitioned_retained_churn_and_compact():
+    rng = random.Random(41)
+    table, rows = _rand_store(rng, n=600)
+    scanner = PartitionedRetainedScanner(table)
+    scanner.scan(["a/+"])  # build the device mirror once
+    # churn: remove a third, add fresh rows, then force a compact
+    victims = rng.sample(sorted(rows), len(rows) // 3)
+    for fid in victims:
+        table.remove(fid)
+        del rows[fid]
+    for i in range(200):
+        t = f"x{i % 7}/y{i % 13}/z{i}"
+        if t not in rows.values():
+            rows[table.add(t)] = t
+    table.compact()
+    filters = _rand_filters(rng, 60) + ["x1/+/#", "x1/y1/+", "#"]
+    got = scanner.scan(filters)
+    for f, matched in zip(filters, got):
+        assert sorted(matched.tolist()) == _scan_expect(rows, f), f"filter={f!r}"
+
+
+def test_partitioned_retained_dollar_isolation():
+    table = RetainedTable()
+    fids = {table.add(t): t for t in ["$SYS/x", "$SYS/x/y", "a/x", "x"]}
+    scanner = PartitionedRetainedScanner(table)
+    got = scanner.scan(["#", "+/x", "$SYS/#", "+/#"])
+    for f, matched in zip(["#", "+/x", "$SYS/#", "+/#"], got):
+        assert sorted(matched.tolist()) == _scan_expect(fids, f), f"filter={f!r}"
+
+
+def test_partitioned_retained_deep_filters():
+    """Filters deeper than the table's max_levels can only match via '#'
+    length rules; the clamped encode must stay exact."""
+    table = RetainedTable()
+    rows = {table.add(t): t for t in
+            ["a/b/c/d/e/f/g/h", "a/b", "a/b/c/d/e/f/g/h/i/j"]}
+    scanner = PartitionedRetainedScanner(table)
+    deep = ["a/b/c/d/e/f/g/h/i/j/k/l", "a/b/c/d/e/f/g/h/#",
+            "a/+/c/d/e/f/g/+/i/j", "a/#"]
+    got = scanner.scan(deep)
+    for f, matched in zip(deep, got):
+        assert sorted(matched.tolist()) == _scan_expect(rows, f), f"filter={f!r}"
+
+
+def test_partitioned_retained_rejects_wildcards():
+    table = RetainedTable()
+    with pytest.raises(ValueError):
+        table.add("a/+/b")
+    with pytest.raises(ValueError):
+        table.add("a/#")
+
+
+def test_filter_masks_shapes():
+    assert ("1", None) in filter_masks(["#"])
+    assert ("4", None, None, None) in filter_masks(["#"])
+    assert filter_masks(["a"]) == [("1", "a")]
+    assert filter_masks(["a", "#"])[0] == ("1", "a")
+    assert ("4", "a", None, "c") in filter_masks(["a", "+", "c", "#"])
+    assert filter_masks(["+", "+"]) == [("2E", None, None)]
+
+
+def test_wide_vocab_dtype_sync():
+    """First scan after the vocabulary crosses the int16 boundary must
+    repack the device tiles as int32 (the flag flips inside _tok_dtype;
+    _refresh must sync it BEFORE pack_device_rows)."""
+    from rmqtt_tpu.ops.encode import _FIRST_TOK
+
+    table = RetainedTable()
+    scanner = PartitionedRetainedScanner(table)
+    # push the vocab just past the int16 threshold, then scan for tokens
+    # on both sides of it in one fresh refresh
+    n = 0x7FFF - _FIRST_TOK + 40
+    for i in range(n):
+        table.add(f"w{i}/x")
+    lo, hi = "w10/x", f"w{n - 1}/x"
+    got = scanner.scan([lo, hi, f"w{n - 1}/+"])
+    assert table._tok_wide
+    assert len(got[0]) == 1 and len(got[1]) == 1 and len(got[2]) == 1
+
+
+def test_retain_store_refuses_wildcard_topics():
+    """A wildcard publish topic (reachable via the HTTP API) must be
+    refused outright, not half-inserted into the tree but not the mirror."""
+    from rmqtt_tpu.broker.retain import RetainStore
+    from rmqtt_tpu.broker.types import Message
+
+    store = RetainStore(tpu=True, tpu_threshold=0)
+    msg = Message(topic="a/+", payload=b"x", qos=0)
+    assert store.set("a/+", msg) is False
+    assert store.count() == 0
+    assert store.set("a/b", Message(topic="a/b", payload=b"x", qos=0))
+    assert [t for t, _m in store.matches("a/+")] == ["a/b"]
+
+
+def test_empty_batch_and_no_match():
+    table = RetainedTable()
+    table.add("a/b")
+    scanner = PartitionedRetainedScanner(table)
+    assert scanner.scan([]) == []
+    (m,) = scanner.scan(["zzz/none"])
+    assert m.tolist() == []
