@@ -75,6 +75,8 @@ class BrokerConfig:
     limit_subscription: bool = False  # enable $limit/$exclusive prefixes
     batch_max: int = 1024
     batch_linger_ms: float = 0.0  # 0 = latency-adaptive (no linger)
+    # max routing batches past submit at once (1 = serial dispatch)
+    routing_pipeline_depth: int = 3
     cluster: bool = False  # use a cluster-aware session registry
     cluster_mode: str = "broadcast"  # "broadcast" | "raft"
     # overload protection (reference busy detection, node.rs:212-239 +
@@ -123,7 +125,10 @@ class ServerContext:
                 router = DefaultRouter(is_online=online)
         self.router = router
         self.routing = RoutingService(
-            router, max_batch=self.cfg.batch_max, linger_ms=self.cfg.batch_linger_ms
+            router,
+            max_batch=self.cfg.batch_max,
+            linger_ms=self.cfg.batch_linger_ms,
+            pipeline_depth=self.cfg.routing_pipeline_depth,
         )
         self.retain = RetainStore(
             enable=self.cfg.retain_enable,

@@ -128,6 +128,12 @@ class RoutingService:
                     batch.append(await asyncio.wait_for(self._q.get(), timeout))
                 except asyncio.TimeoutError:
                     break
+                except asyncio.CancelledError:
+                    # stop() mid-linger: items already popped off the queue
+                    # are invisible to stop()'s drain — reject them here or
+                    # their waiters hang forever
+                    self._reject(batch, RuntimeError("routing service stopped"))
+                    raise
         return batch
 
     def _resolve(self, batch, results) -> None:

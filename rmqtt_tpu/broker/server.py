@@ -544,6 +544,9 @@ async def _amain(args) -> None:
     if args.reuse_port:
         cli.setdefault("listener", {})["reuse_port"] = True
     settings = conf.load(args.config, cli=cli)
+    # [log] section (file/console targets + level, logging.rs analogue);
+    # replaces the bootstrap basicConfig from main()
+    conf.setup_logging(settings.log, verbose=getattr(args, "verbose", False))
     broker = MqttBroker(ServerContext(settings.broker))
     conf.instantiate_plugins(broker.ctx, settings)
     cluster = None

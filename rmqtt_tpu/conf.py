@@ -2,11 +2,13 @@
 
 Mirrors `rmqtt-conf` (`/root/reference/rmqtt-conf/src/lib.rs:42-145`):
 a TOML settings file (sections: node / listener / mqtt / retain / cluster /
-plugins), ``RMQTT_``-prefixed environment overrides with ``__`` section
-separators and list support (reference env override w/ list-keys), and
-command-line arguments merged last (options.rs). Per-plugin config lives
-under ``[plugins.<name>]`` (the reference uses one TOML per plugin in
-``plugins.dir``; a single file with sections is the same surface).
+log / plugins), ``RMQTT_``-prefixed environment overrides with ``__``
+section separators and list support (reference env override w/ list-keys),
+and command-line arguments merged last (options.rs). Per-plugin config
+lives under ``[plugins.<name>]`` (the reference uses one TOML per plugin in
+``plugins.dir``; a single file with sections is the same surface). The
+``[log]`` section (to/level/dir/file) mirrors
+`rmqtt-conf/src/logging.rs`.
 """
 
 from __future__ import annotations
@@ -69,6 +71,70 @@ def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
 
 
 @dataclass
+class LogConfig:
+    """The ``[log]`` section (`rmqtt-conf/src/logging.rs` Log struct):
+    destination (off/file/console/both), severity, and file placement."""
+
+    to: str = "console"  # off | file | console | both
+    level: str = "info"  # off | error | warn | info | debug | trace
+    dir: str = "logs"  # reference default is /var/log/rmqtt; keep writable
+    file: str = "rmqtt.log"
+
+    def filename(self) -> str:
+        """dir + file joined (logging.rs ``Log::filename``)."""
+        if not self.file:
+            return ""
+        if not self.dir:
+            return self.file
+        return f"{self.dir.rstrip('/')}/{self.file}"
+
+
+_LOG_LEVELS = {
+    # trace has no stdlib tier; map to DEBUG like tracing→log bridges do
+    "off": None, "error": 40, "warn": 30, "warning": 30, "info": 20,
+    "debug": 10, "trace": 10,
+}
+
+
+def setup_logging(log: LogConfig, verbose: bool = False) -> None:
+    """Apply the ``[log]`` section to the root logger (file/console
+    handlers, severity); ``verbose`` (CLI ``-v``) forces DEBUG on top."""
+    import logging
+
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+        try:
+            h.close()  # reconfiguration must not leak the old file handle
+        except Exception:
+            pass
+    to = log.to.lower()
+    if to not in ("off", "file", "console", "both"):
+        raise ValueError(f"log.to must be off|file|console|both, got {log.to!r}")
+    level = _LOG_LEVELS.get(log.level.lower())
+    if log.level.lower() not in _LOG_LEVELS:
+        raise ValueError(f"log.level {log.level!r} not recognized")
+    if verbose:
+        level = logging.DEBUG
+    if to == "off" or level is None:
+        root.addHandler(logging.NullHandler())
+        root.setLevel(logging.CRITICAL + 1)
+        return
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s %(message)s")
+    if to in ("console", "both"):
+        h = logging.StreamHandler()
+        h.setFormatter(fmt)
+        root.addHandler(h)
+    if to in ("file", "both") and log.filename():
+        os.makedirs(log.dir or ".", exist_ok=True)
+        h = logging.FileHandler(log.filename())
+        h.setFormatter(fmt)
+        root.addHandler(h)
+    root.setLevel(level)
+
+
+@dataclass
 class Settings:
     """The resolved configuration tree."""
 
@@ -81,6 +147,7 @@ class Settings:
     plugins: Dict[str, Dict[str, Any]]  # name → config
     default_startups: List[str]
     raw: Dict[str, Any]
+    log: LogConfig = field(default_factory=LogConfig)
 
 
 def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
@@ -196,6 +263,13 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
     default_startups = list(plugins_tree.get("default_startups", []))
     plugin_cfgs = {k: v for k, v in plugins_tree.items() if isinstance(v, dict)}
 
+    log_tree = tree.get("log", {})
+    log_fields = {f.name for f in fields(LogConfig)}
+    unknown = set(log_tree) - log_fields
+    if unknown:
+        raise ValueError(f"unknown [log] keys: {sorted(unknown)}")
+    log_cfg = LogConfig(**{k: str(v) for k, v in log_tree.items()})
+
     return Settings(
         broker=BrokerConfig(**broker_kwargs),
         http_api=http_api,
@@ -206,6 +280,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         plugins=plugin_cfgs,
         default_startups=default_startups,
         raw=tree,
+        log=log_cfg,
     )
 
 

@@ -27,6 +27,7 @@ drive it; trie-served batches complete synchronously inside submit.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -46,24 +47,31 @@ class AdaptiveHybrid:
         self._n_large = 0
         self._dev_samples = 0  # first device sample includes XLA compile
         self._last_dev_complete = None  # for pipelined-rate attribution
+        # EMA state is touched from both the submit and the completion
+        # executor threads (RoutingService pipelining); the GIL keeps it
+        # memory-safe but probe cadence / rate attribution would skew —
+        # RLock because _bump_device nests into _bump
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------- internals
     def _bump(self, key: str, rate: float) -> None:
-        cur = self._rate[key]
-        if cur is None or rate > 2.5 * cur or rate < cur / 2.5:
-            # regime jump (compile finished, chip co-located, table grew):
-            # converge immediately instead of over many EMA steps
-            self._rate[key] = rate
-        else:
-            self._rate[key] = (1 - EMA_ALPHA) * cur + EMA_ALPHA * rate
+        with self._lock:
+            cur = self._rate[key]
+            if cur is None or rate > 2.5 * cur or rate < cur / 2.5:
+                # regime jump (compile finished, chip co-located, table grew):
+                # converge immediately instead of over many EMA steps
+                self._rate[key] = rate
+            else:
+                self._rate[key] = (1 - EMA_ALPHA) * cur + EMA_ALPHA * rate
 
     def _bump_device(self, n: int, dt: float) -> None:
         """Device samples skip the first call — it includes JIT compile
         (seconds to minutes at scale) and would pin routing to the trie
         for hundreds of probe cycles."""
-        self._dev_samples += 1
-        if self._dev_samples > 1 and dt > 0:
-            self._bump("device", n / dt)
+        with self._lock:
+            self._dev_samples += 1
+            if self._dev_samples > 1 and dt > 0:
+                self._bump("device", n / dt)
 
     def _side_match(self, topics: Sequence[str]) -> List[np.ndarray]:
         t0 = time.perf_counter()
@@ -82,23 +90,25 @@ class AdaptiveHybrid:
     def _device_match(self, topics: Sequence[str]) -> List[np.ndarray]:
         t0 = time.perf_counter()
         rows = self.matcher.match(topics)
-        self._bump_device(len(topics), time.perf_counter() - t0)
-        self._last_dev_complete = time.perf_counter()
+        with self._lock:
+            self._bump_device(len(topics), time.perf_counter() - t0)
+            self._last_dev_complete = time.perf_counter()
         return rows
 
     def _pick(self) -> str:
         """Route a large batch; probes keep the loser's EMA fresh."""
         if self.probe_every <= 0:
             return "device"  # adaptivity off: fixed size threshold only
-        self._n_large += 1
-        s, d = self._rate["side"], self._rate["device"]
-        if d is None:
-            return "device"
-        if s is None:
-            return "side"
-        if self._n_large % self.probe_every == 0:
-            return "side" if s < d else "device"  # probe the slower path
-        return "side" if s >= d else "device"
+        with self._lock:
+            self._n_large += 1
+            s, d = self._rate["side"], self._rate["device"]
+            if d is None:
+                return "device"
+            if s is None:
+                return "side"
+            if self._n_large % self.probe_every == 0:
+                return "side" if s < d else "device"  # probe the slower path
+            return "side" if s >= d else "device"
 
     # ------------------------------------------------------------------ api
     @property
@@ -137,14 +147,16 @@ class AdaptiveHybrid:
         _kind, payload, n, t_submit = handle
         rows = self.matcher.match_complete(payload)
         now = time.perf_counter()
-        last = self._last_dev_complete
-        if last is not None and last > t_submit:
-            # a device completion landed after this submit: the pipeline is
-            # overlapped, so the inter-completion gap IS the per-batch cost
-            self._bump_device(n, now - last)
-        else:
-            # lone dispatch (e.g. a probe among trie-served batches): the
-            # serial round trip is the honest rate
-            self._bump_device(n, now - t_submit)
-        self._last_dev_complete = now
+        with self._lock:
+            last = self._last_dev_complete
+            if last is not None and last > t_submit:
+                # a device completion landed after this submit: the pipeline
+                # is overlapped, so the inter-completion gap IS the per-batch
+                # cost
+                self._bump_device(n, now - last)
+            else:
+                # lone dispatch (e.g. a probe among trie-served batches): the
+                # serial round trip is the honest rate
+                self._bump_device(n, now - t_submit)
+            self._last_dev_complete = now
         return rows

@@ -17,6 +17,7 @@ to the publishing node, which marks them forwarded here.
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 import time
 from typing import List, Optional, Tuple
@@ -54,6 +55,10 @@ class MessageStoragePlugin(Plugin):
             (ctx.node_id << 48) + (int(time.time() * 1000) & ((1 << 48) - 1))
         )
         self._unhooks = []
+        # buffered forward-marks (see mark_forwarded)
+        self._fwd_pending: dict = {}
+        self._FWD_FLUSH = int(self.config.get("fwd_flush_batch", 256))
+        self._flush_task = None
 
     # ---------------------------------------------- MessageManager surface
     def store_msg(self, msg: Message) -> Optional[int]:
@@ -72,11 +77,40 @@ class MessageStoragePlugin(Plugin):
         (message.rs `mark_forwarded`; called from the live fan-out like
         shared.rs:751-760, and from cross-node ForwardsToAck). The marker
         must outlive the message it guards, so its TTL is at least the
-        message's own expiry when the caller knows it."""
-        self.store.put(
-            NS_FWD, f"{stored_id}\x00{client_id}", True,
-            ttl=max(self.default_expiry, ttl or 0.0),
-        )
+        message's own expiry when the caller knows it.
+
+        Marks are BUFFERED: the live fan-out calls this once per
+        (message, subscriber) on the event-loop hot path, and a synchronous
+        SQLite commit per delivery is O(subscribers) blocking writes per
+        publish. The buffer is the read-side dedup until flushed (one
+        executemany transaction per _FWD_FLUSH marks, plus the periodic
+        sweep in init). A crash loses at most the buffered marks — worst
+        case a QoS1 duplicate replay, which MQTT permits."""
+        exp = time.time() + max(self.default_expiry, ttl or 0.0)
+        self._fwd_pending[f"{stored_id}\x00{client_id}"] = exp
+        if len(self._fwd_pending) >= self._FWD_FLUSH:
+            self.flush_forwarded()
+
+    def flush_forwarded(self) -> None:
+        """Drain the buffered forward-marks in one transaction. On a write
+        failure the batch goes BACK into the buffer (newer marks win) so a
+        transient sqlite error costs a retry, not a duplicate replay."""
+        if not self._fwd_pending:
+            return
+        pending, self._fwd_pending = self._fwd_pending, {}
+        try:
+            self.store.put_many_expire(
+                NS_FWD, [(k, True, exp) for k, exp in pending.items()]
+            )
+        except Exception:
+            pending.update(self._fwd_pending)
+            self._fwd_pending = pending
+            raise
+
+    def _was_forwarded(self, stored_id, client_id: str) -> bool:
+        key = f"{stored_id}\x00{client_id}"
+        return (key in self._fwd_pending
+                or self.store.get(NS_FWD, key) is not None)
 
     def load_unforwarded(
         self, stripped_filter: str, client_id: str, mark: bool = False
@@ -92,7 +126,7 @@ class MessageStoragePlugin(Plugin):
             # round-trip and most stored messages won't match the filter
             if msg.is_expired() or not match_filter(stripped_filter, msg.topic):
                 continue
-            if self.store.get(NS_FWD, f"{msg_id}\x00{client_id}") is not None:
+            if self._was_forwarded(msg_id, client_id):
                 continue
             out.append((int(msg_id), msg))
             if mark:
@@ -161,13 +195,29 @@ class MessageStoragePlugin(Plugin):
             hooks.register(HookType.SESSION_SUBSCRIBED, on_subscribed),
         ]
 
+        async def flush_loop():
+            while True:
+                await asyncio.sleep(0.5)
+                try:
+                    self.flush_forwarded()
+                except Exception:  # failed marks re-buffer; retry next tick
+                    pass
+
+        self._flush_task = asyncio.get_running_loop().create_task(flush_loop())
+
     async def stop(self) -> bool:
         for un in self._unhooks:
             un()
         self._unhooks = []
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
         if getattr(self.ctx, "message_mgr", None) is self:
             self.ctx.message_mgr = None
-        self.store.close()
+        try:
+            self.flush_forwarded()
+        finally:
+            self.store.close()
         return True
 
     def attrs(self):

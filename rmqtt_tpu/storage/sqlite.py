@@ -57,6 +57,15 @@ class SqliteStore:
         )
         self._db.commit()
 
+    def put_many_expire(self, ns: str, items) -> None:
+        """Bulk upsert with per-item absolute expiry: (key, value,
+        expire_at_or_None) triples, one transaction."""
+        self._db.executemany(
+            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+            [(ns, k, wire.dumps(v), exp) for k, v, exp in items],
+        )
+        self._db.commit()
+
     def get(self, ns: str, key: str) -> Optional[Any]:
         row = self._db.execute(
             "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)

@@ -500,3 +500,66 @@ def test_named_listener_config_errors(tmp_path):
             await b.stop()
 
     asyncio.run(dup())
+
+
+def test_conf_log_section(tmp_path):
+    """[log] to/level/dir/file parse + setup_logging honors them
+    (rmqtt-conf/src/logging.rs parity)."""
+    import logging
+
+    from rmqtt_tpu import conf
+
+    cfgf = tmp_path / "lg.toml"
+    logdir = tmp_path / "ld"
+    cfgf.write_text(
+        "[listener]\nport = 1883\n"
+        f"[log]\nto = \"both\"\nlevel = \"warn\"\ndir = \"{logdir}\"\n"
+        "file = \"b.log\"\n"
+    )
+    s = conf.load(str(cfgf))
+    assert s.log.to == "both" and s.log.level == "warn"
+    assert s.log.filename() == f"{logdir}/b.log"
+    prior = list(logging.getLogger().handlers)
+    try:
+        conf.setup_logging(s.log)
+        root = logging.getLogger()
+        assert root.level == logging.WARNING
+        kinds = {type(h).__name__ for h in root.handlers}
+        assert kinds == {"StreamHandler", "FileHandler"}
+        logging.getLogger("x").warning("hello-log-section")
+        for h in root.handlers:
+            h.flush()
+        assert "hello-log-section" in (logdir / "b.log").read_text()
+        # verbose CLI flag overrides the configured level
+        conf.setup_logging(s.log, verbose=True)
+        assert logging.getLogger().level == logging.DEBUG
+    finally:
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in prior:
+            root.addHandler(h)
+        root.setLevel(logging.WARNING)
+
+
+def test_conf_log_defaults_and_errors(tmp_path):
+    from rmqtt_tpu import conf
+
+    cfgf = tmp_path / "d.toml"
+    cfgf.write_text("[listener]\nport = 1883\n")
+    s = conf.load(str(cfgf))
+    assert s.log.to == "console" and s.log.level == "info"
+    bad = tmp_path / "bad.toml"
+    bad.write_text("[log]\nto = \"nowhere\"\n")
+    s2 = conf.load(str(bad))
+    import pytest
+
+    with pytest.raises(ValueError):
+        conf.setup_logging(s2.log)
+    bad2 = tmp_path / "bad2.toml"
+    bad2.write_text("[log]\nnope = 1\n")
+    with pytest.raises(ValueError):
+        conf.load(str(bad2))
+    # env override reaches the section (generic RMQTT_ path mapping)
+    s3 = conf.load(str(cfgf), environ={"RMQTT_LOG__LEVEL": "debug"})
+    assert s3.log.level == "debug"
