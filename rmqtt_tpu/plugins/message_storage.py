@@ -30,7 +30,7 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.cluster.messages import msg_from_wire, msg_to_wire
 from rmqtt_tpu.core.topic import match_filter, parse_shared
 from rmqtt_tpu.plugins import Plugin
-from rmqtt_tpu.storage.sqlite import SqliteStore
+from rmqtt_tpu.storage import make_store
 
 NS_MSG = "msg"
 NS_FWD = "msg_fwd"
@@ -38,11 +38,14 @@ NS_FWD = "msg_fwd"
 
 class MessageStoragePlugin(Plugin):
     name = "rmqtt-message-storage"
-    descr = "store published messages; replay to new subscribers (sqlite)"
+    descr = "store published messages; replay to new subscribers (sqlite or redis)"
 
     def __init__(self, ctx, config=None) -> None:
         super().__init__(ctx, config)
-        self.store = SqliteStore(self.config.get("path", ":memory:"))
+        self.store = make_store(self.config)
+        # network backends must not run their socket round trips on the
+        # event loop (a stalled redis would freeze the whole broker)
+        self._net = bool(getattr(self.store, "network", False))
         self.default_expiry = float(self.config.get("expiry", 300.0))
         self.max_stored = int(self.config.get("max_stored", 100_000))
         # merge_on_read (message.rs:73): pull stored messages from peers at
@@ -81,11 +84,13 @@ class MessageStoragePlugin(Plugin):
 
         Marks are BUFFERED: the live fan-out calls this once per
         (message, subscriber) on the event-loop hot path, and a synchronous
-        SQLite commit per delivery is O(subscribers) blocking writes per
+        store commit per delivery is O(subscribers) blocking writes per
         publish. The buffer is the read-side dedup until flushed (one
-        executemany transaction per _FWD_FLUSH marks, plus the periodic
-        sweep in init). A crash loses at most the buffered marks — worst
-        case a QoS1 duplicate replay, which MQTT permits."""
+        bulk transaction per _FWD_FLUSH marks, plus the 0.5s flush loop
+        started in init — which also expire_sweeps the store every ~60s so
+        dead marks and the network backend's index are reclaimed). A crash
+        loses at most the buffered marks — worst case a QoS1 duplicate
+        replay, which MQTT permits."""
         exp = time.time() + max(self.default_expiry, ttl or 0.0)
         self._fwd_pending[f"{stored_id}\x00{client_id}"] = exp
         if len(self._fwd_pending) >= self._FWD_FLUSH:
@@ -119,18 +124,27 @@ class MessageStoragePlugin(Plugin):
         forwarded to ``client_id`` (message.rs `get`). With ``mark`` the
         returned batch is immediately marked forwarded — the MessageGet RPC
         handler uses this so a remote replay can't repeat."""
-        out: List[Tuple[int, Message]] = []
+        cands: List[Tuple[int, Message]] = []
         for msg_id, mw in self.store.scan(NS_MSG):
             msg = msg_from_wire(mw)
             # cheap in-memory checks first; the forwarded lookup is a store
-            # round-trip and most stored messages won't match the filter
+            # round trip and most stored messages won't match the filter
             if msg.is_expired() or not match_filter(stripped_filter, msg.topic):
                 continue
-            if self._was_forwarded(msg_id, client_id):
+            cands.append((int(msg_id), msg))
+        if not cands:
+            return []
+        # ONE batched forwarded lookup for the whole candidate set (on the
+        # network backend a per-candidate GET would cost one RTT each)
+        fwd_keys = [f"{sid}\x00{client_id}" for sid, _ in cands]
+        hit = self.store.get_many(NS_FWD, fwd_keys)
+        out: List[Tuple[int, Message]] = []
+        for (sid, msg), key, marked in zip(cands, fwd_keys, hit):
+            if marked is not None or key in self._fwd_pending:
                 continue
-            out.append((int(msg_id), msg))
+            out.append((sid, msg))
             if mark:
-                self.mark_forwarded(int(msg_id), client_id, ttl=msg.expiry_interval)
+                self.mark_forwarded(sid, client_id, ttl=msg.expiry_interval)
         return out
 
     def count(self) -> int:
@@ -145,7 +159,11 @@ class MessageStoragePlugin(Plugin):
             msg = prev if prev is not None else args[1]
             if msg.topic.startswith("$"):
                 return None
-            sid = self.store_msg(msg)
+            if self._net:
+                sid = await asyncio.get_running_loop().run_in_executor(
+                    None, self.store_msg, msg)
+            else:
+                sid = self.store_msg(msg)
             if sid is None:
                 return None
             # the stored id rides the Message through the fan-out so local
@@ -162,7 +180,12 @@ class MessageStoragePlugin(Plugin):
             except ValueError:
                 return None
             replay: List[Tuple[int, Message]] = []
-            for sid, msg in self.load_unforwarded(stripped, id.client_id):
+            if self._net:
+                loaded = await asyncio.get_running_loop().run_in_executor(
+                    None, self.load_unforwarded, stripped, id.client_id)
+            else:
+                loaded = self.load_unforwarded(stripped, id.client_id)
+            for sid, msg in loaded:
                 replay.append((sid, msg))
                 self.mark_forwarded(sid, id.client_id, ttl=msg.expiry_interval)
             # merge_on_read: pull peers' unforwarded stored messages
@@ -196,10 +219,18 @@ class MessageStoragePlugin(Plugin):
         ]
 
         async def flush_loop():
+            loop = asyncio.get_running_loop()
+            tick = 0
             while True:
                 await asyncio.sleep(0.5)
+                tick += 1
                 try:
-                    self.flush_forwarded()
+                    if self._net:
+                        await loop.run_in_executor(None, self.flush_forwarded)
+                    else:
+                        self.flush_forwarded()
+                    if tick % 120 == 0:  # ~60s: reclaim expired rows/marks
+                        await loop.run_in_executor(None, self.store.expire_sweep)
                 except Exception:  # failed marks re-buffer; retry next tick
                     pass
 

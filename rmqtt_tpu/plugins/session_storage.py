@@ -23,13 +23,13 @@ NS = "session"
 
 class SessionStoragePlugin(Plugin):
     name = "rmqtt-session-storage"
-    descr = "persistent sessions + offline queues (sqlite)"
+    descr = "persistent sessions + offline queues (sqlite or redis)"
 
     def __init__(self, ctx, config=None) -> None:
         super().__init__(ctx, config)
-        from rmqtt_tpu.storage.sqlite import SqliteStore
+        from rmqtt_tpu.storage import make_store
 
-        self.store = SqliteStore(self.config.get("path", ":memory:"))
+        self.store = make_store(self.config)
         self._unhooks = []
 
     def _snapshot(self, s: Session) -> dict:

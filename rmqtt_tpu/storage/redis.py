@@ -1,0 +1,297 @@
+"""Redis (RESP2) KV store backend — dependency-free wire client.
+
+The reference's retainer/message/session stores run over ``rmqtt-storage``
+with sled OR redis backends (`rmqtt-plugins/rmqtt-retainer/src/lib.rs:26-94`,
+``StorageType::Redis``); this module completes that story here: the same
+``SqliteStore`` surface (put/get/delete/scan/count/expire_sweep + bulk
+variants) over a hand-rolled RESP client, selected by a ``redis://`` URL
+through :func:`rmqtt_tpu.storage.make_store`.
+
+Data model (per logical namespace ``ns``):
+
+- ``{prefix}:{ns}:{key}``  → ``wire.dumps(value)`` with per-key PEXPIREAT
+  when a TTL is given (redis expires server-side — ``expire_sweep`` only
+  self-heals the index);
+- ``{prefix}:__ns__:{ns}`` → a SET of the namespace's keys, giving O(1)
+  ``count`` and snapshot ``scan`` without server-wide SCAN walks.
+
+The client is synchronous (the store API is synchronous; broker-control
+rates), pipelines every bulk operation into one socket write, and retries
+once through a reconnect on a dropped connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from rmqtt_tpu.cluster import wire
+
+
+class RespError(RuntimeError):
+    pass
+
+
+def encode_command(*args) -> bytes:
+    """RESP array-of-bulk-strings encoding of one command."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        b = a if isinstance(a, bytes) else str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class _Reader:
+    """Incremental RESP reply parser over a blocking socket."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._buf = b""
+
+    def _fill(self) -> None:
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("redis connection closed")
+        self._buf += chunk
+
+    def _line(self) -> bytes:
+        while True:
+            i = self._buf.find(b"\r\n")
+            if i >= 0:
+                line, self._buf = self._buf[:i], self._buf[i + 2:]
+                return line
+            self._fill()
+
+    def _exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            self._fill()
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def reply(self):
+        line = self._line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RespError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n < 0 else self._exact(n)
+        if t == b"*":
+            n = int(rest)
+            return None if n < 0 else [self.reply() for _ in range(n)]
+        raise RespError(f"bad RESP type byte {t!r}")
+
+
+class RedisClient:
+    """Minimal synchronous RESP2 client (PING/SELECT on connect)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0, timeout: float = 5.0) -> None:
+        self.host, self.port, self.db, self.timeout = host, port, db, timeout
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[_Reader] = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self.close()
+        s = socket.create_connection((self.host, self.port), self.timeout)
+        s.settimeout(self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock, self._reader = s, _Reader(s)
+        # handshake INLINE (not via call/pipeline): pipeline retries through
+        # _connect, so routing the handshake back through it would recurse
+        # unboundedly against an accept-then-drop server
+        cmds = [encode_command("SELECT", self.db)] if self.db else []
+        cmds.append(encode_command("PING"))
+        self._send_all(cmds)
+        for _ in cmds:
+            self._reader.reply()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._reader = None
+
+    def _send_all(self, cmds: List[bytes]) -> None:
+        assert self._sock is not None
+        self._sock.sendall(b"".join(cmds))
+
+    def call(self, *args):
+        (r,) = self.pipeline([args])
+        return r
+
+    def pipeline(self, commands: List[Tuple]) -> List[Any]:
+        """Send every command in one write; read all replies in order.
+        One reconnect-and-retry on a dropped connection — redis commands
+        used here are idempotent upserts/deletes. An in-band ``-ERR`` reply
+        mid-batch drains the REMAINING replies before raising (leaving them
+        buffered would desync every later call into reading stale replies),
+        then drops the connection for a clean slate — our command set never
+        nests errors inside arrays, but a fresh connection is proof."""
+        payload = [encode_command(*c) for c in commands]
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._send_all(payload)
+                out: List[Any] = []
+                first_err: Optional[RespError] = None
+                for _ in commands:
+                    try:
+                        out.append(self._reader.reply())
+                    except RespError as e:
+                        out.append(e)
+                        first_err = first_err or e
+                if first_err is not None:
+                    self.close()
+                    raise first_err
+                return out
+            except (ConnectionError, socket.timeout, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+
+class RedisStore:
+    """``SqliteStore``-surface KV store over RESP (see module docstring)."""
+
+    #: network-backed: callers on the event loop must hop to an executor
+    network = True
+
+    def __init__(self, url: str = "redis://127.0.0.1:6379/0",
+                 prefix: str = "rmqtt") -> None:
+        u = urlparse(url)
+        if u.scheme not in ("redis", "resp"):
+            raise ValueError(f"not a redis url: {url!r}")
+        db = int(u.path.lstrip("/")) if u.path.lstrip("/") else 0
+        self.prefix = prefix
+        self._c = RedisClient(u.hostname or "127.0.0.1", u.port or 6379, db)
+
+    # --------------------------------------------------------------- keys
+    def _k(self, ns: str, key: str) -> str:
+        return f"{self.prefix}:{ns}:{key}"
+
+    def _nsk(self, ns: str) -> str:
+        return f"{self.prefix}:__ns__:{ns}"
+
+    # ----------------------------------------------------------------- kv
+    def close(self) -> None:
+        self._c.close()
+
+    def put(self, ns: str, key: str, value: Any, ttl: Optional[float] = None) -> None:
+        self.put_many_expire(
+            ns, [(key, value, time.time() + ttl if ttl else None)])
+
+    def put_many(self, ns: str, items) -> None:
+        self.put_many_expire(ns, [(k, v, None) for k, v in items])
+
+    def put_many_expire(self, ns: str, items) -> None:
+        cmds: List[Tuple] = []
+        for k, v, exp in items:
+            cmds.append(("SET", self._k(ns, k), wire.dumps(v)))
+            if exp is not None:
+                cmds.append(("PEXPIREAT", self._k(ns, k), int(exp * 1000)))
+            else:
+                cmds.append(("PERSIST", self._k(ns, k)))
+            cmds.append(("SADD", self._nsk(ns), k))
+        if cmds:
+            self._c.pipeline(cmds)
+
+    def get(self, ns: str, key: str) -> Optional[Any]:
+        raw = self._c.call("GET", self._k(ns, key))
+        return None if raw is None else wire.loads(raw)
+
+    def get_many(self, ns: str, keys) -> List[Optional[Any]]:
+        """One MGET round trip for N keys (the data-path batch read)."""
+        keys = list(keys)
+        if not keys:
+            return []
+        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys])
+        return [None if raw is None else wire.loads(raw) for raw in vals]
+
+    def delete(self, ns: str, key: str) -> bool:
+        n, _ = self._c.pipeline([
+            ("DEL", self._k(ns, key)), ("SREM", self._nsk(ns), key)])
+        return bool(n)
+
+    def delete_int_upto(self, ns: str, n: int) -> int:
+        """Delete every key whose integer value is <= n (raft log
+        compaction: keys are 1-based absolute log indices)."""
+        members = self._c.call("SMEMBERS", self._nsk(ns)) or []
+        victims = []
+        for m in members:
+            k = m.decode()
+            try:
+                if int(k) <= n:
+                    victims.append(k)
+            except ValueError:
+                continue
+        if not victims:
+            return 0
+        cmds = [("DEL", *[self._k(ns, k) for k in victims]),
+                ("SREM", self._nsk(ns), *victims)]
+        deleted, _ = self._c.pipeline(cmds)
+        return int(deleted)
+
+    def scan(self, ns: str) -> List[Tuple[str, Any]]:
+        members = self._c.call("SMEMBERS", self._nsk(ns)) or []
+        if not members:
+            return []
+        keys = [m.decode() for m in members]
+        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys])
+        out: List[Tuple[str, Any]] = []
+        gone: List[str] = []
+        for k, raw in zip(keys, vals):
+            if raw is None:  # expired server-side; heal the index
+                gone.append(k)
+            else:
+                out.append((k, wire.loads(raw)))
+        if gone:
+            self._c.call("SREM", self._nsk(ns), *gone)
+        return out
+
+    def count(self, ns: str) -> int:
+        # SCARD on the per-ns index: expired-but-unhealed keys inflate it
+        # until a scan() or expire_sweep() heals the set, so this is an
+        # UPPER BOUND between sweeps — callers using it as a limit gauge
+        # (max_stored) must run expire_sweep periodically (the
+        # message-storage flush loop does)
+        return int(self._c.call("SCARD", self._nsk(ns)) or 0)
+
+    def expire_sweep(self) -> int:
+        """Redis expires keys itself; this self-heals the per-ns indexes
+        and reports how many dead entries were dropped."""
+        removed = 0
+        cursor = 0
+        pat = f"{self.prefix}:__ns__:*"
+        while True:
+            cursor, batch = self._c.call("SCAN", cursor, "MATCH", pat,
+                                         "COUNT", 512)
+            for nskey in batch or []:
+                nskey = nskey.decode()
+                ns = nskey.split(":", 2)[2]
+                members = self._c.call("SMEMBERS", nskey) or []
+                if not members:
+                    continue
+                keys = [m.decode() for m in members]
+                alive = self._c.pipeline(
+                    [("EXISTS", self._k(ns, k)) for k in keys])
+                gone = [k for k, a in zip(keys, alive) if not a]
+                if gone:
+                    self._c.call("SREM", nskey, *gone)
+                    removed += len(gone)
+            cursor = int(cursor)
+            if cursor == 0:
+                break
+        return removed

@@ -17,6 +17,9 @@ from rmqtt_tpu.cluster import wire
 
 
 class SqliteStore:
+    #: embedded backend: small synchronous ops are event-loop safe
+    network = False
+
     def __init__(self, path: str | Path = ":memory:") -> None:
         self.path = str(path)
         if self.path != ":memory:":
@@ -51,11 +54,7 @@ class SqliteStore:
     def put_many(self, ns: str, items) -> None:
         """Bulk upsert in ONE transaction (large raft appends must not pay a
         commit per row)."""
-        self._db.executemany(
-            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,NULL)",
-            [(ns, k, wire.dumps(v)) for k, v in items],
-        )
-        self._db.commit()
+        self.put_many_expire(ns, [(k, v, None) for k, v in items])
 
     def put_many_expire(self, ns: str, items) -> None:
         """Bulk upsert with per-item absolute expiry: (key, value,
@@ -77,6 +76,10 @@ class SqliteStore:
             self.delete(ns, key)
             return None
         return wire.loads(value)
+
+    def get_many(self, ns: str, keys) -> List[Optional[Any]]:
+        """Batch get (surface parity with the network backend's MGET)."""
+        return [self.get(ns, k) for k in keys]
 
     def delete(self, ns: str, key: str) -> bool:
         cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))

@@ -1,0 +1,185 @@
+"""In-image fake redis: a threaded RESP2 server implementing exactly the
+command subset RedisStore uses (SET/GET/MGET/DEL/EXISTS/PERSIST/PEXPIREAT/
+SADD/SREM/SCARD/SMEMBERS/SCAN/SELECT/PING/FLUSHALL), with real per-key
+expiry. The test double for the redis backend, in the same spirit as the
+Kafka bridge's fake broker."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+
+def _enc_bulk(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return b"$-1\r\n"
+    return b"$%d\r\n%s\r\n" % (len(b), b)
+
+
+def _enc(obj) -> bytes:
+    if obj is None:
+        return b"$-1\r\n"
+    if isinstance(obj, bool):
+        return b":%d\r\n" % int(obj)
+    if isinstance(obj, int):
+        return b":%d\r\n" % obj
+    if isinstance(obj, bytes):
+        return _enc_bulk(obj)
+    if isinstance(obj, str):
+        return b"+%s\r\n" % obj.encode()
+    if isinstance(obj, (list, tuple)):
+        return b"*%d\r\n" % len(obj) + b"".join(_enc(x) for x in obj)
+    raise TypeError(type(obj))
+
+
+class FakeRedis:
+    def __init__(self) -> None:
+        self._kv: Dict[bytes, bytes] = {}
+        self._exp: Dict[bytes, float] = {}
+        self._sets: Dict[bytes, Set[bytes]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self.drop_next = 0  # test hook: close the next N connections mid-use
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- engine
+    def _alive(self, key: bytes) -> bool:
+        exp = self._exp.get(key)
+        if exp is not None and exp <= time.time():
+            self._kv.pop(key, None)
+            self._exp.pop(key, None)
+            return False
+        return key in self._kv
+
+    def _dispatch(self, cmd: bytes, args) -> object:
+        name = cmd.upper()
+        with self._lock:
+            if name in (b"PING",):
+                return "PONG"
+            if name == b"SELECT":
+                return "OK"
+            if name == b"FLUSHALL":
+                self._kv.clear(); self._exp.clear(); self._sets.clear()
+                return "OK"
+            if name == b"SET":
+                self._kv[args[0]] = args[1]
+                self._exp.pop(args[0], None)
+                return "OK"
+            if name == b"GET":
+                return self._kv.get(args[0]) if self._alive(args[0]) else None
+            if name == b"MGET":
+                return [self._kv.get(k) if self._alive(k) else None for k in args]
+            if name == b"DEL":
+                n = 0
+                for k in args:
+                    if self._alive(k):
+                        n += 1
+                    self._kv.pop(k, None)
+                    self._exp.pop(k, None)
+                return n
+            if name == b"EXISTS":
+                return sum(1 for k in args if self._alive(k))
+            if name == b"PERSIST":
+                return int(self._exp.pop(args[0], None) is not None)
+            if name == b"PEXPIREAT":
+                if not self._alive(args[0]):
+                    return 0
+                self._exp[args[0]] = int(args[1]) / 1000.0
+                return 1
+            if name == b"SADD":
+                s = self._sets.setdefault(args[0], set())
+                n = len(args) - 1 - len(s.intersection(args[1:]))
+                s.update(args[1:])
+                return n
+            if name == b"SREM":
+                s = self._sets.get(args[0], set())
+                n = len(s.intersection(args[1:]))
+                s.difference_update(args[1:])
+                return n
+            if name == b"SCARD":
+                return len(self._sets.get(args[0], ()))
+            if name == b"SMEMBERS":
+                return sorted(self._sets.get(args[0], ()))
+            if name == b"SCAN":
+                # single-pass cursor: return everything matching, cursor 0
+                pat = b"*"
+                for i, a in enumerate(args):
+                    if a.upper() == b"MATCH":
+                        pat = args[i + 1]
+                prefix = pat.rstrip(b"*")
+                keys = [k for k in self._sets if k.startswith(prefix)]
+                return [b"0", keys]
+            raise ValueError(f"fake redis: unsupported {name!r}")
+
+    # ---------------------------------------------------------- transport
+    def _accept(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _read_line(self, conn, buf: bytearray) -> Tuple[bytes, bytearray]:
+        while b"\r\n" not in buf:
+            d = conn.recv(65536)
+            if not d:
+                raise ConnectionError
+            buf += d
+        i = buf.index(b"\r\n")
+        return bytes(buf[:i]), buf[i + 2:]
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = bytearray()
+        served = 0
+        try:
+            while True:
+                line, buf = self._read_line(conn, buf)
+                assert line[:1] == b"*", line
+                nargs = int(line[1:])
+                parts = []
+                for _ in range(nargs):
+                    hdr, buf = self._read_line(conn, buf)
+                    assert hdr[:1] == b"$"
+                    n = int(hdr[1:])
+                    while len(buf) < n + 2:
+                        d = conn.recv(65536)
+                        if not d:
+                            raise ConnectionError
+                        buf += d
+                    parts.append(bytes(buf[:n]))
+                    buf = buf[n + 2:]
+                if self.drop_next > 0 and served > 0:
+                    self.drop_next -= 1
+                    conn.close()
+                    return
+                try:
+                    res = self._dispatch(parts[0], parts[1:])
+                    conn.sendall(_enc(res))
+                except ValueError as e:
+                    conn.sendall(b"-ERR %s\r\n" % str(e).encode())
+                served += 1
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

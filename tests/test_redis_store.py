@@ -1,0 +1,191 @@
+"""RedisStore (RESP backend) against the fake redis server: surface parity
+with SqliteStore, TTL expiry, index healing, reconnect, and plugin
+round-trips (retainer + message storage over redis)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tests.fake_redis import FakeRedis
+
+
+@pytest.fixture()
+def redis_url():
+    srv = FakeRedis()
+    yield f"redis://127.0.0.1:{srv.port}/0", srv
+    srv.close()
+
+
+def _store(url):
+    from rmqtt_tpu.storage.redis import RedisStore
+
+    return RedisStore(url)
+
+
+def test_basic_kv_roundtrip(redis_url):
+    url, _srv = redis_url
+    st = _store(url)
+    st.put("ns", "a", {"x": 1})
+    st.put("ns", "b", [1, 2, 3])
+    st.put("other", "a", "separate-namespace")
+    assert st.get("ns", "a") == {"x": 1}
+    assert st.get("ns", "b") == [1, 2, 3]
+    assert st.get("other", "a") == "separate-namespace"
+    assert st.get("ns", "missing") is None
+    assert st.count("ns") == 2
+    assert sorted(st.scan("ns")) == [("a", {"x": 1}), ("b", [1, 2, 3])]
+    assert st.delete("ns", "a") is True
+    assert st.delete("ns", "a") is False
+    assert st.count("ns") == 1
+    st.close()
+
+
+def test_ttl_expiry_and_index_heal(redis_url):
+    url, _srv = redis_url
+    st = _store(url)
+    st.put("ns", "gone", "v", ttl=0.15)
+    st.put("ns", "stays", "v")
+    assert st.get("ns", "gone") == "v"
+    time.sleep(0.2)
+    assert st.get("ns", "gone") is None
+    # scan self-heals the index; count converges after the sweep
+    assert st.scan("ns") == [("stays", "v")]
+    assert st.expire_sweep() == 0  # scan already healed it
+    assert st.count("ns") == 1
+    st.put("ns", "gone2", "v", ttl=0.15)
+    time.sleep(0.2)
+    assert st.expire_sweep() == 1
+    assert st.count("ns") == 1
+    st.close()
+
+
+def test_put_overwrites_and_clears_ttl(redis_url):
+    url, _srv = redis_url
+    st = _store(url)
+    st.put("ns", "k", "v1", ttl=30.0)
+    st.put("ns", "k", "v2")  # overwrite without ttl must PERSIST
+    time.sleep(0.02)
+    assert st.get("ns", "k") == "v2"
+    st.close()
+
+
+def test_bulk_and_delete_int_upto(redis_url):
+    url, _srv = redis_url
+    st = _store(url)
+    st.put_many("log", [(str(i), f"entry{i}") for i in range(1, 11)])
+    st.put_many_expire("log", [("tagged", "x", time.time() + 30)])
+    assert st.count("log") == 11
+    assert st.delete_int_upto("log", 7) == 7
+    assert {k for k, _ in st.scan("log")} == {"8", "9", "10", "tagged"}
+    st.close()
+
+
+def test_error_reply_does_not_desync(redis_url):
+    """An in-band -ERR mid-pipeline must drain the remaining replies and
+    leave later calls reading the RIGHT replies (not stale ones)."""
+    from rmqtt_tpu.storage.redis import RespError
+
+    url, _srv = redis_url
+    st = _store(url)
+    st.put("ns", "k", "v")
+    with pytest.raises(RespError):
+        st._c.pipeline([("SET", "rmqtt:ns:x", b"1"), ("BOGUS",),
+                        ("SET", "rmqtt:ns:y", b"2")])
+    assert st.get("ns", "k") == "v"  # connection state still coherent
+    st.close()
+
+
+def test_reconnect_retry(redis_url):
+    url, srv = redis_url
+    st = _store(url)
+    st.put("ns", "k", "v")
+    srv.drop_next = 1  # server closes the connection mid-stream once
+    assert st.get("ns", "k") == "v"  # client must reconnect and retry
+    st.close()
+
+
+def test_make_store_selection(redis_url, tmp_path):
+    url, _srv = redis_url
+    from rmqtt_tpu.storage import make_store
+    from rmqtt_tpu.storage.redis import RedisStore
+    from rmqtt_tpu.storage.sqlite import SqliteStore
+
+    assert isinstance(make_store({"storage": url}), RedisStore)
+    assert isinstance(make_store({"path": str(tmp_path / "a.db")}), SqliteStore)
+    assert isinstance(make_store(
+        {"storage": f"sqlite://{tmp_path}/b.db"}), SqliteStore)
+    assert isinstance(make_store(None), SqliteStore)
+    with pytest.raises(ValueError):
+        make_store({"storage": "mongodb://nope"})
+
+
+def test_sqlite_surface_differential(redis_url, tmp_path):
+    """Same op sequence against both backends -> same observable state."""
+    url, _srv = redis_url
+    from rmqtt_tpu.storage.sqlite import SqliteStore
+
+    stores = [_store(url), SqliteStore(str(tmp_path / "d.db"))]
+    for st in stores:
+        st.put("ns", "a", 1)
+        st.put("ns", "b", {"k": [1, "2"]}, ttl=60)
+        st.put_many("ns", [("c", "cc"), ("d", "dd")])
+        st.delete("ns", "c")
+    views = [(sorted(st.scan("ns")), st.count("ns"),
+              st.get("ns", "b"), st.get("ns", "zzz")) for st in stores]
+    assert views[0] == views[1]
+    for st in stores:
+        st.close()
+
+
+def test_retainer_plugin_over_redis(redis_url):
+    import asyncio
+
+    url, _srv = redis_url
+    from rmqtt_tpu.broker.context import ServerContext
+    from rmqtt_tpu.broker.types import Message
+    from rmqtt_tpu.plugins.retainer import RetainerPlugin
+
+    async def run():
+        ctx = ServerContext()
+        p = RetainerPlugin(ctx, {"storage": url})
+        await p.init()
+        await p.start()
+        msg = Message(topic="r/t", payload=b"keep", qos=1, retain=True)
+        assert ctx.retain.set("r/t", msg)
+        assert p.attrs()["persisted"] == 1
+        await p.stop()
+        # a fresh context + plugin over the same redis reloads the retain
+        ctx2 = ServerContext()
+        p2 = RetainerPlugin(ctx2, {"storage": url})
+        await p2.init()
+        await p2.start()
+        assert [t for t, _m in ctx2.retain.matches("r/+")] == ["r/t"]
+        await p2.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_message_storage_over_redis(redis_url):
+    import asyncio
+
+    url, _srv = redis_url
+    from rmqtt_tpu.broker.context import ServerContext
+    from rmqtt_tpu.broker.types import Message
+    from rmqtt_tpu.plugins.message_storage import MessageStoragePlugin
+
+    async def run():
+        ctx = ServerContext()
+        p = MessageStoragePlugin(ctx, {"storage": url})
+        await p.init()
+        sid = p.store_msg(Message(topic="m/t", payload=b"x", qos=1))
+        assert sid is not None
+        assert [s for s, _m in p.load_unforwarded("m/#", "c1")] == [sid]
+        p.mark_forwarded(sid, "c1")
+        assert p.load_unforwarded("m/#", "c1") == []
+        p.flush_forwarded()
+        assert p.load_unforwarded("m/#", "c1") == []  # post-flush: via store
+        await p.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
