@@ -60,6 +60,10 @@ class DeliverItem:
     topic_filter: str
     sub_ids: Tuple[int, ...] = ()
     dup: bool = False
+    # encoded-frame cache SHARED across one publish's fan-out (the fan-out
+    # loop passes one dict per message): QoS0 subscribers on the same
+    # protocol version reuse identical wire bytes instead of re-encoding
+    wire_cache: dict = field(default_factory=dict)
 
 
 class Session:
@@ -299,7 +303,9 @@ class SessionState:
 
     # ------------------------------------------------------------------ io
     async def send(self, packet) -> None:
-        data = self.codec.encode(packet)
+        await self.send_raw(self.codec.encode(packet))
+
+    async def send_raw(self, data: bytes) -> None:
         async with self._wlock:
             self.writer.write(data)
             # drain only under backpressure: an await per delivered message
@@ -450,6 +456,29 @@ class SessionState:
                     retain=item.retain, wire_props=dict(props),
                 )
             )
+        # QoS0 fan-out fast path: for subscribers of the same protocol
+        # version the wire frame is byte-identical (no packet id, no
+        # per-subscription props, alias disabled), so encode ONCE per
+        # publish and reuse the bytes across the whole fan-out — the
+        # per-delivery encode was the hot loop's dominant cost
+        # (shared.rs:876-963's preserialized-clone analogue)
+        if (item.qos == 0 and not item.sub_ids and not (
+                self.codec.version == pk.V5
+                and s.limits.max_topic_aliases_out > 0)):
+            key = (self.codec.version, item.retain, rem)
+            cache = item.wire_cache
+            data = cache.get(key)
+            if data is None:
+                pub = pk.Publish(
+                    topic=msg.topic, payload=msg.payload, qos=0,
+                    retain=item.retain, dup=False, packet_id=None,
+                    properties=props if self.codec.version == pk.V5 else {},
+                )
+                data = cache[key] = self.codec.encode(pub)
+            await self.send_raw(data)
+            self.ctx.metrics.inc("messages.delivered")
+            await self.ctx.hooks.fire(HookType.MESSAGE_DELIVERED, s.id, msg, None)
+            return
         # outbound topic alias AFTER the drop checks: an alias must never be
         # registered for a publish that does not reach the wire (the client
         # would see later empty-topic reuses as 0x94 protocol errors)

@@ -170,15 +170,18 @@ class SessionRegistry:
             return 1
         relmap = await self.ctx.routing.matches(msg.from_id, msg.topic)
         count = 0
+        wire_cache: dict = {}  # one encoded-frame cache per fan-out
         for node_id, relations in relmap.items():
             # single-node: everything is local; cluster mode dispatches
             # remote nodes over the cluster backend (round 2+)
             for rel in relations:
-                count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+                count += self._deliver_local(rel.id.client_id, rel.topic_filter,
+                                             rel.opts, msg, wire_cache)
         return count
 
     def _deliver_local(
-        self, client_id: str, topic_filter: str, opts: SubscriptionOptions, msg: Message
+        self, client_id: str, topic_filter: str, opts: SubscriptionOptions,
+        msg: Message, wire_cache: Optional[dict] = None,
     ) -> int:
         session = self._sessions.get(client_id)
         if session is None:
@@ -191,6 +194,7 @@ class SessionRegistry:
                 retain=retain,
                 topic_filter=topic_filter,
                 sub_ids=opts.subscription_ids,
+                wire_cache=wire_cache if wire_cache is not None else {},
             )
         )
         self._mark_forwarded(msg, client_id)

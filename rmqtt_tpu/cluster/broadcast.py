@@ -71,9 +71,11 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
             count, recipients = 1, [body["p2p"]]
         else:
+            wire_cache: dict = {}  # shared per inbound fan-out
             for rw in body["rels"]:
                 rel = M.relation_from_wire(rw)
-                if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
+                if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter,
+                                               rel.opts, msg, wire_cache):
                     count += 1
                     recipients.append(rel.id.client_id)
         # fire-and-forget mark-forwarded ack back to the publishing node
@@ -100,7 +102,16 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
         mgr = getattr(ctx, "message_mgr", None)
         if mgr is None:
             return {"msgs": []}
-        rows = mgr.load_unforwarded(body["filter"], body["client_id"], mark=True)
+        if getattr(mgr, "_net", False):
+            # network store: the scan is multiple socket RTTs — off-loop
+            import asyncio as _aio
+
+            rows = await _aio.get_running_loop().run_in_executor(
+                None, mgr.load_unforwarded, body["filter"],
+                body["client_id"], True)
+        else:
+            rows = mgr.load_unforwarded(body["filter"], body["client_id"],
+                                        mark=True)
         return {"msgs": [[sid, M.msg_to_wire(m)] for sid, m in rows]}
     if mtype == M.KICK:
         session = ctx.registry.get(body["client_id"])
@@ -347,9 +358,11 @@ class ClusterSessionRegistry(ClusterRegistryBase):
     def _deliver_relmap(self, relmap, msg: Message) -> Tuple[int, List[str]]:
         count = 0
         recipients: List[str] = []
+        wire_cache: dict = {}  # shared per fan-out (frame reuse)
         for _node, rels in relmap.items():
             for rel in rels:
-                if self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg):
+                if self._deliver_local(rel.id.client_id, rel.topic_filter,
+                                       rel.opts, msg, wire_cache):
                     count += 1
                     recipients.append(rel.id.client_id)
         return count, recipients

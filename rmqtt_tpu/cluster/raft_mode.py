@@ -136,10 +136,11 @@ class RaftSessionRegistry(ClusterRegistryBase):
         relmap, shared = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
         count = 0
         remote: Dict[int, List[SubRelation]] = {}
+        wire_cache: dict = {}  # shared per fan-out (frame reuse)
         for node_id, rels in relmap.items():
             if node_id == self.ctx.node_id:
                 for rel in rels:
-                    count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg)
+                    count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg, wire_cache)
             else:
                 remote.setdefault(node_id, []).extend(rels)
         # shared groups: all candidates are in the replicated table — choose

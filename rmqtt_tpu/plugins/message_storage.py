@@ -62,6 +62,7 @@ class MessageStoragePlugin(Plugin):
         self._fwd_pending: dict = {}
         self._FWD_FLUSH = int(self.config.get("fwd_flush_batch", 256))
         self._flush_task = None
+        self._flush_inflight = False  # threshold-flush executor guard
 
     # ---------------------------------------------- MessageManager surface
     def store_msg(self, msg: Message) -> Optional[int]:
@@ -94,7 +95,29 @@ class MessageStoragePlugin(Plugin):
         exp = time.time() + max(self.default_expiry, ttl or 0.0)
         self._fwd_pending[f"{stored_id}\x00{client_id}"] = exp
         if len(self._fwd_pending) >= self._FWD_FLUSH:
-            self.flush_forwarded()
+            if not self._net:
+                self.flush_forwarded()  # embedded: one cheap transaction
+                return
+            # network backend: the threshold flush must NOT run its socket
+            # RTT inline (this is the event-loop fan-out hot path when
+            # called from _deliver_local); hand it to the executor unless
+            # one is already in flight — or flush directly when we are
+            # ALREADY on a worker thread (load_unforwarded(mark=True))
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                self.flush_forwarded()
+                return
+            if not self._flush_inflight:
+                self._flush_inflight = True
+
+                def _bg():
+                    try:
+                        self.flush_forwarded()
+                    finally:
+                        self._flush_inflight = False
+
+                loop.run_in_executor(None, _bg)
 
     def flush_forwarded(self) -> None:
         """Drain the buffered forward-marks in one transaction. On a write

@@ -30,10 +30,22 @@ class SessionStoragePlugin(Plugin):
         from rmqtt_tpu.storage import make_store
 
         self.store = make_store(self.config)
+        # network backend: connect/disconnect hooks must not run socket
+        # round trips on the event loop (same invariant as message_storage)
+        self._net = bool(getattr(self.store, "network", False))
         self._unhooks = []
 
     def _snapshot(self, s: Session) -> dict:
         return session_snapshot(s)
+
+    async def _store_call(self, fn, *args, **kw):
+        if self._net:
+            import asyncio
+            import functools
+
+            return await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(fn, *args, **kw))
+        return fn(*args, **kw)
 
     async def init(self) -> None:
         hooks = self.ctx.hooks
@@ -44,17 +56,18 @@ class SessionStoragePlugin(Plugin):
             # persistence is governed by the session expiry alone
             s = self.ctx.registry.get(id.client_id)
             if s is not None and s.limits.session_expiry > 0:
-                self.store.put(NS, s.client_id, self._snapshot(s),
-                               ttl=s.limits.session_expiry)
+                snap = self._snapshot(s)  # snapshot on-loop (consistent view)
+                await self._store_call(self.store.put, NS, s.client_id, snap,
+                                       ttl=s.limits.session_expiry)
             return None
 
         async def on_terminated(_ht, args, _prev):
-            self.store.delete(NS, args[0].client_id)
+            await self._store_call(self.store.delete, NS, args[0].client_id)
             return None
 
         async def on_connected(_ht, args, _prev):
             # the live broker now owns this session again
-            self.store.delete(NS, args[0].id.client_id)
+            await self._store_call(self.store.delete, NS, args[0].id.client_id)
             return None
 
         self._unhooks = [

@@ -23,6 +23,7 @@ once through a reconnect on a dropped connection.
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from typing import Any, List, Optional, Tuple
 from urllib.parse import urlparse
@@ -96,6 +97,10 @@ class RedisClient:
         self.host, self.port, self.db, self.timeout = host, port, db, timeout
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[_Reader] = None
+        # ONE socket, many callers (executor workers, write-behind threads,
+        # the event loop): a lock serializes whole request/response cycles
+        # or two threads would interleave reads and desync the stream
+        self._lock = threading.Lock()
         self._connect()
 
     def _connect(self) -> None:
@@ -139,27 +144,28 @@ class RedisClient:
         then drops the connection for a clean slate — our command set never
         nests errors inside arrays, but a fresh connection is proof."""
         payload = [encode_command(*c) for c in commands]
-        for attempt in (0, 1):
-            try:
-                if self._sock is None:
-                    self._connect()
-                self._send_all(payload)
-                out: List[Any] = []
-                first_err: Optional[RespError] = None
-                for _ in commands:
-                    try:
-                        out.append(self._reader.reply())
-                    except RespError as e:
-                        out.append(e)
-                        first_err = first_err or e
-                if first_err is not None:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._send_all(payload)
+                    out: List[Any] = []
+                    first_err: Optional[RespError] = None
+                    for _ in commands:
+                        try:
+                            out.append(self._reader.reply())
+                        except RespError as e:
+                            out.append(e)
+                            first_err = first_err or e
+                    if first_err is not None:
+                        self.close()
+                        raise first_err
+                    return out
+                except (ConnectionError, socket.timeout, OSError):
                     self.close()
-                    raise first_err
-                return out
-            except (ConnectionError, socket.timeout, OSError):
-                self.close()
-                if attempt:
-                    raise
+                    if attempt:
+                        raise
         raise AssertionError("unreachable")
 
 

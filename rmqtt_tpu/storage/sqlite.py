@@ -9,6 +9,7 @@ blocking readers across broker restarts/chaos tests.
 from __future__ import annotations
 
 import sqlite3
+import threading
 import time
 from pathlib import Path
 from typing import Any, Iterable, List, Optional, Tuple
@@ -24,7 +25,12 @@ class SqliteStore:
         self.path = str(path)
         if self.path != ":memory:":
             Path(self.path).parent.mkdir(parents=True, exist_ok=True)
-        self._db = sqlite3.connect(self.path)
+        # callers occasionally hop store work to executor threads (expire
+        # sweeps, network-parity paths): one connection, externally
+        # serialized by _lock (sqlite3 objects must not be used
+        # concurrently), created thread-agnostic
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(
@@ -40,16 +46,18 @@ class SqliteStore:
         self._db.commit()
 
     def close(self) -> None:
-        self._db.close()
+        with self._lock:
+            self._db.close()
 
     # ------------------------------------------------------------------ kv
     def put(self, ns: str, key: str, value: Any, ttl: Optional[float] = None) -> None:
         expire = time.time() + ttl if ttl else None
-        self._db.execute(
-            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
-            (ns, key, wire.dumps(value), expire),
-        )
-        self._db.commit()
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+                (ns, key, wire.dumps(value), expire),
+            )
+            self._db.commit()
 
     def put_many(self, ns: str, items) -> None:
         """Bulk upsert in ONE transaction (large raft appends must not pay a
@@ -59,16 +67,18 @@ class SqliteStore:
     def put_many_expire(self, ns: str, items) -> None:
         """Bulk upsert with per-item absolute expiry: (key, value,
         expire_at_or_None) triples, one transaction."""
-        self._db.executemany(
-            "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
-            [(ns, k, wire.dumps(v), exp) for k, v, exp in items],
-        )
-        self._db.commit()
+        with self._lock:
+            self._db.executemany(
+                "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+                [(ns, k, wire.dumps(v), exp) for k, v, exp in items],
+            )
+            self._db.commit()
 
     def get(self, ns: str, key: str) -> Optional[Any]:
-        row = self._db.execute(
-            "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
-        ).fetchone()
+        with self._lock:
+            row = self._db.execute(
+                "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
+            ).fetchone()
         if row is None:
             return None
         value, expire = row
@@ -82,24 +92,27 @@ class SqliteStore:
         return [self.get(ns, k) for k in keys]
 
     def delete(self, ns: str, key: str) -> bool:
-        cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))
-        self._db.commit()
-        return cur.rowcount > 0
+        with self._lock:
+            cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))
+            self._db.commit()
+            return cur.rowcount > 0
 
     def delete_int_upto(self, ns: str, n: int) -> int:
         """Delete every key whose integer value is <= n (raft log compaction:
         keys are 1-based absolute log indices)."""
-        cur = self._db.execute(
-            "DELETE FROM kv WHERE ns = ? AND CAST(k AS INTEGER) <= ?", (ns, n)
-        )
-        self._db.commit()
-        return cur.rowcount
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM kv WHERE ns = ? AND CAST(k AS INTEGER) <= ?", (ns, n)
+            )
+            self._db.commit()
+            return cur.rowcount
 
     def scan(self, ns: str) -> List[Tuple[str, Any]]:
         nw = time.time()
-        rows = self._db.execute(
-            "SELECT k, v, expire_at FROM kv WHERE ns=?", (ns,)
-        ).fetchall()
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT k, v, expire_at FROM kv WHERE ns=?", (ns,)
+            ).fetchall()
         out = []
         for k, v, expire in rows:
             if expire is not None and expire <= nw:
@@ -108,12 +121,16 @@ class SqliteStore:
         return out
 
     def count(self, ns: str) -> int:
-        (n,) = self._db.execute("SELECT COUNT(*) FROM kv WHERE ns=?", (ns,)).fetchone()
+        with self._lock:
+            (n,) = self._db.execute(
+                "SELECT COUNT(*) FROM kv WHERE ns=?", (ns,)).fetchone()
         return int(n)
 
     def expire_sweep(self) -> int:
-        cur = self._db.execute(
-            "DELETE FROM kv WHERE expire_at IS NOT NULL AND expire_at <= ?", (time.time(),)
-        )
-        self._db.commit()
-        return cur.rowcount
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM kv WHERE expire_at IS NOT NULL AND expire_at <= ?",
+                (time.time(),)
+            )
+            self._db.commit()
+            return cur.rowcount
