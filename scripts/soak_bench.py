@@ -105,7 +105,7 @@ async def shard_main(args) -> None:
         n = min(args.wave, args.conns - start)
         results = await asyncio.gather(
             *(open_one(args.broker_port, f"soak-{args.shard_id}-{start + i}",
-                       host=f"127.0.0.{1 + (start + i) % 8}")
+                       host=f"127.0.0.{1 + (start + i) % 32}")
               for i in range(n)),
             return_exceptions=True,
         )
