@@ -105,7 +105,7 @@ async def shard_main(args) -> None:
         n = min(args.wave, args.conns - start)
         results = await asyncio.gather(
             *(open_one(args.broker_port, f"soak-{args.shard_id}-{start + i}",
-                       host=f"127.0.0.{1 + (start + i) % 32}")
+                       host=f"127.0.0.{1 + (start + i) % args.aliases}")
               for i in range(n)),
             return_exceptions=True,
         )
@@ -164,6 +164,15 @@ async def main() -> None:
                     help="client shard processes (20000-fd cap each)")
     ap.add_argument("--workers", type=int, default=1,
                     help="broker --workers (20000-fd cap per worker)")
+    def _aliases(v: str) -> int:
+        n = int(v)
+        if not 1 <= n <= 255:  # single 127.0.0.x octet
+            raise argparse.ArgumentTypeError("--aliases must be 1..255")
+        return n
+
+    ap.add_argument("--aliases", type=_aliases, default=32,
+                    help="loopback dial aliases, 1-255 (capacity ≈ aliases × "
+                         "~28K ephemeral ports per SO_REUSEPORT listener port)")
     ap.add_argument("--shard-id", type=int, default=None,
                     help=argparse.SUPPRESS)  # internal: run as a shard child
     args = ap.parse_args()
@@ -208,7 +217,8 @@ async def main() -> None:
             shards.append(subprocess.Popen(
                 [sys.executable, __file__, "--conns", str(n),
                  "--broker-port", str(args.broker_port),
-                 "--wave", str(args.wave), "--shard-id", str(sid)],
+                 "--wave", str(args.wave), "--aliases", str(args.aliases),
+                 "--shard-id", str(sid)],
                 cwd=str(repo), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 text=True,
             ))
