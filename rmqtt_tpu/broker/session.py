@@ -509,7 +509,13 @@ class SessionState:
         s = self.s
         while True:
             wait = s.out_inflight.next_retry_in()
-            await asyncio.sleep(wait if wait is not None else s.out_inflight.retry_interval)
+            if wait is None:
+                # empty window: block until a QoS1/2 delivery is in flight
+                # instead of waking every retry_interval — at connection
+                # scale the idle wakeups alone saturate the core
+                await s.out_inflight.wait_nonempty()
+                continue
+            await asyncio.sleep(wait)
             for e in s.out_inflight.due():
                 if not s.out_inflight.mark_retry(e):
                     await self.ctx.hooks.fire(

@@ -534,3 +534,24 @@ async def test_routing_service_stats_surface(broker):
     assert st["routing_dispatched_items"] >= 5
     assert st["routing_batch_size_ema"] >= 1
     assert "routing_queued" in st and "routing_inflight_batches" in st
+
+
+@broker_test
+async def test_qos1_live_retry_without_reconnect(broker):
+    """An unacked QoS1 delivery is RETRANSMITTED with DUP=1 on the live
+    connection once retry_interval elapses (inflight.rs retry sweep; the
+    retry loop is event-woken now, so this pins that an in-flight entry
+    still gets its timer)."""
+    sub = await connect(broker, "liveretry")
+    await sub.subscribe("lr/t", qos=1)
+    sub.auto_ack = False  # receive but never PUBACK
+    # shrink the retry clock AFTER the session exists
+    sess = broker.ctx.registry.get("liveretry")
+    sess.out_inflight.retry_interval = 0.3
+    pub = await connect(broker, "liveretry-pub")
+    await pub.publish("lr/t", b"again", qos=1)
+    first = await sub.recv()
+    assert first.qos == 1 and not first.dup
+    again = await sub.recv(timeout=5)
+    assert again.payload == b"again" and again.dup, "live retransmit must set DUP"
+    await pub.disconnect_clean()
