@@ -77,7 +77,12 @@ async def open_one(port: int, cid: str, retries: int = 3,
         try:
             reader, writer = await asyncio.open_connection(host, port)
             codec = MqttCodec()
-            writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+            # keepalive=0: a hold-measurement client sends no traffic and no
+            # PINGREQs, so any nonzero keepalive makes the broker correctly
+            # reap every connection 1.5x keepalive after CONNECT — a >900s
+            # ramp then bleeds earlier connections while later ones dial
+            # (measured: the first 1M attempt peaked at 729K then drained)
+            writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=0)))
             await writer.drain()
             while True:
                 data = await reader.read(64)
