@@ -189,3 +189,39 @@ def test_message_storage_over_redis(redis_url):
         await p.stop()
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_retainer_redis_with_tpu_scan_path(redis_url):
+    """Persistence (redis) + the partitioned TPU scan path together: retains
+    set through a tpu-enabled store persist to redis, reload into a fresh
+    context, and replay through the inverse-match kernel."""
+    import asyncio
+
+    url, _srv = redis_url
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.types import Message
+    from rmqtt_tpu.plugins.retainer import RetainerPlugin
+
+    async def run():
+        cfg = BrokerConfig(retain_tpu=True, retain_tpu_threshold=0)
+        ctx = ServerContext(cfg)
+        p = RetainerPlugin(ctx, {"storage": url})
+        await p.init()
+        await p.start()
+        for t in ("ha/k1/temp", "ha/k2/temp", "ha/k2/hum"):
+            assert ctx.retain.set(t, Message(topic=t, payload=b"v", qos=0,
+                                            retain=True))
+        # force the kernel path and check it against expectations
+        got = sorted(t for t, _m in ctx.retain.matches("ha/+/temp"))
+        assert got == ["ha/k1/temp", "ha/k2/temp"]
+        await p.stop()
+        # fresh context (fresh TPU mirror) reloads from redis
+        ctx2 = ServerContext(BrokerConfig(retain_tpu=True, retain_tpu_threshold=0))
+        p2 = RetainerPlugin(ctx2, {"storage": url})
+        await p2.init()
+        await p2.start()
+        got2 = sorted(t for t, _m in ctx2.retain.matches("ha/#"))
+        assert got2 == ["ha/k1/temp", "ha/k2/hum", "ha/k2/temp"]
+        await p2.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
