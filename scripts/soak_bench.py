@@ -110,6 +110,7 @@ async def shard_main(args) -> None:
         n = min(args.wave, args.conns - start)
         results = await asyncio.gather(
             *(open_one(args.broker_port, f"soak-{args.shard_id}-{start + i}",
+                       retries=args.dial_retries,
                        host=f"127.0.0.{1 + (start + i) % args.aliases}")
               for i in range(n)),
             return_exceptions=True,
@@ -192,6 +193,9 @@ async def main() -> None:
     ap.add_argument("--aliases", type=_aliases, default=32,
                     help="loopback dial aliases, 1-255 (capacity ≈ aliases × "
                          "~28K ephemeral ports per SO_REUSEPORT listener port)")
+    ap.add_argument("--dial-retries", type=int, default=3,
+                    help="client dial attempts per connection (exponential-ish "
+                         "backoff; raise for heavily contended big ramps)")
     ap.add_argument("--flat-workers", action="store_true",
                     help="spawn the workers as INDEPENDENT brokers sharing "
                          "the port via SO_REUSEPORT, with NO cluster between "
@@ -266,6 +270,7 @@ async def main() -> None:
                 [sys.executable, __file__, "--conns", str(n),
                  "--broker-port", str(args.broker_port),
                  "--wave", str(args.wave), "--aliases", str(args.aliases),
+                 "--dial-retries", str(args.dial_retries),
                  "--shard-id", str(sid)],
                 cwd=str(repo), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 text=True,
