@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""25-min endurance: sustained QoS0/QoS1 fan-out bursts + client churn
+against one broker; RSS sampled each minute (leak check for the round-5
+delivery-path changes: frame cache, event-driven retry, buffered marks)."""
+import asyncio, os, subprocess, sys, time
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+
+PORT = 18933
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+proc = subprocess.Popen([sys.executable, "-m", "rmqtt_tpu.broker", "--port",
+                         str(PORT), "--no-http-api"], env=env,
+                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+def rss_mb():
+    for line in open(f"/proc/{proc.pid}/status"):
+        if line.startswith("VmRSS"):
+            return int(line.split()[1]) / 1024.0
+    return 0.0
+
+async def connect(cid, qos=0):
+    for _ in range(100):
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", PORT)
+            break
+        except OSError:
+            await asyncio.sleep(0.2)
+    c = MqttCodec()
+    w.write(c.encode(pk.Connect(client_id=cid, keepalive=0)))
+    await w.drain()
+    while True:
+        if any(isinstance(p, pk.Connack) for p in c.feed(await r.read(256))):
+            return r, w, c
+
+async def subscriber(cid, topic, qos, stop, counts):
+    r, w, c = await connect(cid)
+    w.write(c.encode(pk.Subscribe(1, [(topic, pk.SubOpts(qos=qos))])))
+    await w.drain()
+    try:
+        while not stop.is_set():
+            try:
+                data = await asyncio.wait_for(r.read(65536), 1.0)
+            except asyncio.TimeoutError:
+                continue
+            if not data:
+                return
+            for p in c.feed(data):
+                if isinstance(p, pk.Publish):
+                    counts[0] += 1
+                    if p.qos == 1:
+                        w.write(c.encode(pk.Puback(p.packet_id)))
+            await w.drain()
+    finally:
+        w.close()
+
+async def main():
+    stop = asyncio.Event()
+    counts = [0]
+    subs = [asyncio.create_task(subscriber(f"es{i}", "et/t", i % 2, stop, counts))
+            for i in range(30)]
+    await asyncio.sleep(2)
+    pr, pw, pc = await connect("epub")
+    t_end = time.time() + 25 * 60
+    sent = 0
+    mid = 0
+    print(f"start rss={rss_mb():.1f}MB")
+    last_mark = time.time()
+    churn_n = 0
+    while time.time() < t_end:
+        for _ in range(200):
+            mid = mid % 60000 + 1
+            pw.write(pc.encode(pk.Publish(topic="et/t", payload=b"x" * 64,
+                                          qos=1, packet_id=mid)))
+        await pw.drain()
+        sent += 200
+        # drain our own acks
+        try:
+            data = await asyncio.wait_for(pr.read(65536), 0.5)
+            pc.feed(data)
+        except asyncio.TimeoutError:
+            pass
+        # churn: every ~20s kill and replace a subscriber
+        if time.time() - last_mark > 20:
+            last_mark = time.time()
+            churn_n += 1
+            victim = subs.pop(0)
+            victim.cancel()
+            subs.append(asyncio.create_task(
+                subscriber(f"churn{churn_n}", "et/t", churn_n % 2, stop, counts)))
+            print(f"t={25*60-(t_end-time.time()):.0f}s sent={sent} "
+                  f"delivered={counts[0]} rss={rss_mb():.1f}MB", flush=True)
+        await asyncio.sleep(0.05)
+    stop.set()
+    await asyncio.sleep(2)
+    print(f"END sent={sent} delivered={counts[0]} rss={rss_mb():.1f}MB")
+    for t in subs:
+        t.cancel()
+
+try:
+    asyncio.run(main())
+finally:
+    proc.terminate()
