@@ -77,6 +77,15 @@ class BrokerConfig:
     batch_linger_ms: float = 0.0  # 0 = latency-adaptive (no linger)
     # max routing batches past submit at once (1 = serial dispatch)
     routing_pipeline_depth: int = 3
+    # epoch-versioned publish→relations match cache (router/cache.py):
+    # repeat-topic publishes skip the matcher entirely; entries invalidate
+    # by per-first-segment epochs (exact filters) / a global wildcard epoch
+    route_cache: bool = True
+    route_cache_capacity: int = 8192
+    # don't cache topics that match $share groups (the round-robin choice
+    # is per-publish either way; bypass trades hit rate for zero reuse of
+    # shared candidate sets)
+    route_cache_shared_bypass: bool = False
     cluster: bool = False  # use a cluster-aware session registry
     cluster_mode: str = "broadcast"  # "broadcast" | "raft"
     # overload protection (reference busy detection, node.rs:212-239 +
@@ -129,6 +138,9 @@ class ServerContext:
             max_batch=self.cfg.batch_max,
             linger_ms=self.cfg.batch_linger_ms,
             pipeline_depth=self.cfg.routing_pipeline_depth,
+            cache_enable=self.cfg.route_cache,
+            cache_capacity=self.cfg.route_cache_capacity,
+            cache_shared_bypass=self.cfg.route_cache_shared_bypass,
         )
         self.retain = RetainStore(
             enable=self.cfg.retain_enable,

@@ -509,7 +509,8 @@ _DASHBOARD_HTML = b"""<!doctype html>
 const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "topics","routes","retaineds","delayed_publishs","message_queues",
  "out_inflights","in_inflights","handshakings","handshakings_active",
- "handshakings_rate","forwards","message_storages"];
+ "handshakings_rate","forwards","message_storages",
+ "routing_cache_size","routing_cache_hits","routing_cache_misses"];
 async function j(p){const r=await fetch(p);if(!r.ok)throw new Error(p+": "+r.status);return r.json()}
 // client ids / topics / usernames are ATTACKER-CHOSEN (any MQTT client);
 // everything interpolated into markup must be escaped

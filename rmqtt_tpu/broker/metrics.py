@@ -52,6 +52,16 @@ class Stats:
         # cluster forwarding ops + stored offline messages (stats.rs:95-98)
         self.forwards = 0
         self.message_storages = 0
+        # routing match-result cache gauges (router/cache.py), overwritten
+        # from RoutingService.stats() in ServerContext.stats(); declared
+        # here so the observability surface is shape-stable even before the
+        # routing service starts (tier-1 pins these keys)
+        self.routing_cache_size = 0
+        self.routing_cache_hits = 0
+        self.routing_cache_misses = 0
+        self.routing_cache_invalidations = 0
+        self.routing_cache_evictions = 0
+        self.routing_cache_door_rejects = 0
 
     def to_json(self) -> Dict[str, int]:
         return dict(vars(self))

@@ -15,6 +15,15 @@ under load the previous dispatch's service time naturally accumulates the
 next batch (the classic adaptive-batching scheme — batch size tracks load
 with no tuning knob). An optional ``linger_ms > 0`` restores a bounded wait
 for workloads that prefer fuller device batches over first-packet latency.
+
+In FRONT of the queue sits an epoch-versioned match-result cache
+(`rmqtt_tpu/router/cache.py`): repeat-topic publishes — the dominant regime
+under zipf-skewed IoT traffic — resolve synchronously from the cached
+expanded relations and never enter the batcher, so device/native batches
+shrink to misses only. Misses are deduplicated per dispatch (one match per
+DISTINCT topic, matched with ``from_id=None``) and the per-publish result is
+derived from the shared entry (No-Local re-filtered, shared-group liveness
+re-flagged, round-robin choice still per publish).
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import asyncio
 from typing import List, Optional, Tuple
 
 from rmqtt_tpu.router.base import Id, Router, SubRelationsMap
+from rmqtt_tpu.router.cache import MatchCache
 
 
 class RoutingService:
@@ -33,6 +43,9 @@ class RoutingService:
         linger_ms: float = 0.0,
         max_queue: int = 100_000,
         pipeline_depth: int = 3,
+        cache_enable: bool = True,
+        cache_capacity: int = 8192,
+        cache_shared_bypass: bool = False,
     ) -> None:
         self.router = router
         self.max_batch = max_batch
@@ -49,6 +62,20 @@ class RoutingService:
         self._pipe_sem: Optional[asyncio.Semaphore] = None  # built in start()
         self._completion_q: asyncio.Queue = asyncio.Queue()
         self._completer: Optional[asyncio.Task] = None
+        # epoch-versioned match-result cache (pre-queue fast path). The
+        # cache is only sound for routers that OPT IN via epochs_tracked
+        # (their add/remove bump Router.epochs on every mutation); any
+        # other router — duck-typed or a custom Router subclass that never
+        # bumps — runs uncached rather than risk stale serves
+        self.cache: Optional[MatchCache] = None
+        if (cache_enable and cache_capacity > 0
+                and getattr(router, "epochs_tracked", False)):
+            self.cache = MatchCache(
+                router.epochs,
+                capacity=cache_capacity,
+                shared_bypass=cache_shared_bypass,
+                is_online=getattr(router, "_is_online", lambda cid: True),
+            )
         # observability (TaskExecStats analogue, context.rs:506-555):
         # dispatch counts + an EMA of batch size, surfaced via ctx.stats()
         self.dispatches = 0
@@ -60,12 +87,21 @@ class RoutingService:
         """Gauges for the admin surface (per-exec stats parity). The _ema
         key is average-mode for cluster merging (counter.rs AVG), not a
         summable count — /stats/sum treats the suffix accordingly."""
+        c = self.cache
         return {
             "routing_queued": self._q.qsize(),
             "routing_inflight_batches": self.inflight,
             "routing_dispatches": self.dispatches,
             "routing_dispatched_items": self.dispatched_items,
             "routing_batch_size_ema": round(self.batch_size_ema, 1),
+            # match-result cache gauges (zeros when the cache is disabled so
+            # the observability surface stays shape-stable for dashboards)
+            "routing_cache_size": len(c) if c is not None else 0,
+            "routing_cache_hits": c.hits if c is not None else 0,
+            "routing_cache_misses": c.misses if c is not None else 0,
+            "routing_cache_invalidations": c.invalidations if c is not None else 0,
+            "routing_cache_evictions": c.evictions if c is not None else 0,
+            "routing_cache_door_rejects": c.door_rejects if c is not None else 0,
         }
 
     def start(self) -> None:
@@ -89,24 +125,49 @@ class RoutingService:
         # reject everything still parked in either queue — those waiters
         # would otherwise await forever (e.g. forwards() during shutdown)
         while not self._completion_q.empty():
-            batch, _handle = self._completion_q.get_nowait()
+            batch, _groups, _handle = self._completion_q.get_nowait()
             self._reject(batch, RuntimeError("routing service stopped"))
         while not self._q.empty():
             item = self._q.get_nowait()
             self._reject([item], RuntimeError("routing service stopped"))
 
+    def _cache_lookup(self, topic: str):
+        """Pre-queue fast path: the entry for ``topic`` if current."""
+        if self.cache is None:
+            return None
+        return self.cache.get(topic)
+
     async def matches(self, from_id: Optional[Id], topic: str) -> SubRelationsMap:
-        # NOTE: even for prefer_inline routers the queue round trip stays —
-        # its yield is load-bearing: a read loop processing a whole TCP
-        # chunk of publishes would otherwise starve the deliver loops and
-        # overflow bounded deliver queues (measured: QoS0 drops under
-        # flood). Inline dispatch happens in _run instead.
+        relmap, _hit = await self.matches_for_fanout(from_id, topic)
+        return relmap
+
+    async def matches_for_fanout(
+        self, from_id: Optional[Id], topic: str
+    ) -> Tuple[SubRelationsMap, bool]:
+        """``(relations, cache_hit)`` — the fan-out entry point. A cache hit
+        resolves synchronously (never enters the batcher); a miss parks on
+        the ingress queue as before.
+
+        NOTE: even for prefer_inline routers the MISS path keeps the queue
+        round trip — its yield is load-bearing: a read loop processing a
+        whole TCP chunk of publishes would otherwise starve the deliver
+        loops and overflow bounded deliver queues (measured: QoS0 drops
+        under flood). The hit path preserves that cooperative yield with an
+        explicit sleep(0), still far cheaper than the queue round trip."""
+        entry = self._cache_lookup(topic)
+        if entry is not None:
+            await asyncio.sleep(0)
+            return self.router.collapse(self.cache.derive(entry, from_id)), True
         fut = asyncio.get_running_loop().create_future()
         await self._q.put((from_id, topic, fut, False))
-        return await fut
+        return await fut, False
 
     async def matches_raw(self, from_id: Optional[Id], topic: str):
         """Un-collapsed variant for cluster-global shared-group choice."""
+        entry = self._cache_lookup(topic)
+        if entry is not None:
+            await asyncio.sleep(0)  # keep the cooperative yield (see above)
+            return self.cache.derive(entry, from_id)
         fut = asyncio.get_running_loop().create_future()
         await self._q.put((from_id, topic, fut, True))
         return await fut
@@ -136,16 +197,64 @@ class RoutingService:
                     raise
         return batch
 
-    def _resolve(self, batch, results) -> None:
-        for (_, _, fut, raw), res in zip(batch, results):
-            if fut.done():
-                continue
-            try:
-                fut.set_result(res if raw else self.router.collapse(res))
-            except Exception as e:
-                # a collapse failure (e.g. a shared-sub strategy callback
-                # bug) must reject ITS waiter, not kill the service task
-                fut.set_exception(e)
+    def _plan(self, batch):
+        """→ (match items, per-item waiter groups or None).
+
+        Without the cache, items mirror the batch 1:1. With it, misses are
+        DEDUPLICATED per distinct topic and matched with ``from_id=None``
+        (No-Local is re-applied per waiter at resolve time) so a burst of
+        publishes to one hot topic costs one match; epoch snapshots are
+        taken here — BEFORE the match runs — so a subscribe landing while
+        the batch is in flight makes the entry born-stale, never wrong."""
+        if self.cache is None:
+            return [(fid, topic) for fid, topic, _, _ in batch], None
+        order: dict = {}
+        items: list = []
+        groups: list = []
+        for i, (_fid, topic, _fut, _raw) in enumerate(batch):
+            j = order.get(topic)
+            if j is None:
+                order[topic] = len(items)
+                items.append((None, topic))
+                groups.append(([i], self.cache.snapshot(topic)))
+            else:
+                groups[j][0].append(i)
+        return items, groups
+
+    def _resolve(self, batch, results, groups=None) -> None:
+        if groups is None:
+            for (_, _, fut, raw), res in zip(batch, results):
+                if fut.done():
+                    continue
+                try:
+                    fut.set_result(res if raw else self.router.collapse(res))
+                except Exception as e:
+                    # a collapse failure (e.g. a shared-sub strategy callback
+                    # bug) must reject ITS waiter, not kill the service task
+                    fut.set_exception(e)
+            return
+        for (idxs, snap), res in zip(groups, results):
+            topic = batch[idxs[0]][1]
+            entry = self.cache.put(topic, res, snap)
+            # ONE waiter may consume the fresh raw directly (its containers
+            # are unaliased until collapse mutates them); the rest derive
+            # copies from the entry. No-Local publishers always derive, and
+            # a transient (unstored) entry ALIASES the raw, so the raw may
+            # only be consumed directly when no other waiter derives from it
+            raw_free = entry.stored or len(idxs) == 1
+            for i in idxs:
+                fid, _topic, fut, raw = batch[i]
+                if fut.done():
+                    continue
+                try:
+                    if raw_free and (fid is None or not entry.has_no_local):
+                        derived, raw_free = res, False
+                    else:
+                        derived = self.cache.derive(entry, fid)
+                    fut.set_result(
+                        derived if raw else self.router.collapse(derived))
+                except Exception as e:
+                    fut.set_exception(e)
 
     @staticmethod
     def _reject(batch, exc) -> None:
@@ -172,7 +281,7 @@ class RoutingService:
                 raise
 
     async def _dispatch_one(self, loop, batch, inline_ok, pipelined) -> None:
-        items = [(fid, topic) for fid, topic, _, _ in batch]
+        items, groups = self._plan(batch)
         self.dispatches += 1
         self.dispatched_items += len(items)
         self.batch_size_ema = (
@@ -181,7 +290,7 @@ class RoutingService:
         )
         if inline_ok(len(items)):
             try:
-                self._resolve(batch, self.router.matches_batch_raw(items))
+                self._resolve(batch, self.router.matches_batch_raw(items), groups)
             except Exception as e:
                 self._reject(batch, e)
             return
@@ -209,9 +318,9 @@ class RoutingService:
                 # a completion-queue round trip on it
                 self.inflight -= 1
                 self._pipe_sem.release()
-                self._resolve(batch, payload)
+                self._resolve(batch, payload, groups)
                 return
-            await self._completion_q.put((batch, payload))
+            await self._completion_q.put((batch, groups, payload))
             return
         self.inflight += 1
         try:
@@ -223,12 +332,12 @@ class RoutingService:
             return
         finally:
             self.inflight -= 1
-        self._resolve(batch, results)
+        self._resolve(batch, results, groups)
 
     async def _complete_loop(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            batch, handle = await self._completion_q.get()
+            batch, groups, handle = await self._completion_q.get()
             try:
                 results = await loop.run_in_executor(
                     None, self.router.complete_batch_raw, handle
@@ -240,7 +349,7 @@ class RoutingService:
             except Exception as e:
                 self._reject(batch, e)
             else:
-                self._resolve(batch, results)
+                self._resolve(batch, results, groups)
             finally:
                 self.inflight -= 1
                 self._pipe_sem.release()

@@ -168,7 +168,19 @@ class SessionRegistry:
             )
             self._mark_forwarded(msg, msg.target_clientid)
             return 1
-        relmap = await self.ctx.routing.matches(msg.from_id, msg.topic)
+        # routed through the epoch-versioned match cache when the topic is
+        # hot: the collapsed map comes straight from the cached expansion
+        # (shared-group choice still per publish) and never enters the
+        # batcher; the QoS0 wire_cache below then reuses encode work WITHIN
+        # the fan-out, so a hot topic pays neither match nor re-encode
+        relmap, cache_hit = await self.ctx.routing.matches_for_fanout(
+            msg.from_id, msg.topic)
+        if self.ctx.routing.cache is not None:
+            # only meaningful with the cache on — counting misses while
+            # disabled would read as a malfunctioning cache (0% hit rate)
+            self.ctx.metrics.inc(
+                "messages.route_cache_hit" if cache_hit
+                else "messages.route_cache_miss")
         count = 0
         wire_cache: dict = {}  # one encoded-frame cache per fan-out
         for node_id, relations in relmap.items():

@@ -14,7 +14,11 @@ lives under ``[plugins.<name>]`` (the reference uses one TOML per plugin in
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomllib landed in 3.11
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -131,6 +135,12 @@ def setup_logging(log: LogConfig, verbose: bool = False) -> None:
         h = logging.FileHandler(log.filename())
         h.setFormatter(fmt)
         root.addHandler(h)
+    if not root.handlers:
+        # to="file" with an empty filename: without a handler the bare
+        # setLevel below would leak WARNING+ records to stderr through
+        # logging.lastResort — pin a NullHandler so "file sink, nowhere to
+        # write" stays silent like to="off"
+        root.addHandler(logging.NullHandler())
     root.setLevel(level)
 
 
@@ -232,6 +242,31 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
             broker_kwargs["retain_tpu"] = bool(retain["tpu"])
         if "tpu_threshold" in retain:
             broker_kwargs["retain_tpu_threshold"] = int(retain["tpu_threshold"])
+
+    # [routing] — batcher + match-result cache knobs (broker/routing.py,
+    # router/cache.py); flat names here map onto BrokerConfig fields
+    routing = tree.get("routing", {})
+    _ROUTING_KEYS = {
+        "cache": "route_cache",
+        "cache_capacity": "route_cache_capacity",
+        "cache_shared_bypass": "route_cache_shared_bypass",
+        "batch_max": "batch_max",
+        "linger_ms": "batch_linger_ms",
+        "pipeline_depth": "routing_pipeline_depth",
+    }
+    unknown_routing = set(routing) - set(_ROUTING_KEYS)
+    if unknown_routing:
+        raise ValueError(f"unknown [routing] keys: {sorted(unknown_routing)}")
+    for key, field_name in _ROUTING_KEYS.items():
+        if key in routing:
+            v = routing[key]
+            if key in ("cache", "cache_shared_bypass"):
+                v = bool(v)
+            elif key == "linger_ms":
+                v = float(v)
+            else:
+                v = int(v)
+            broker_kwargs[field_name] = v
 
     cluster_listen = None
     raft_db = None

@@ -99,6 +99,23 @@ class RetainedTable(PartitionedTable):
         # filter string → (chunk ids, version) candidate cache
         self._fcand_cache: Dict[str, np.ndarray] = {}
         self._fcand_version = -1
+        # version-keyed row→fid snapshot for in-flight scans (fid_snapshot)
+        self._fid_snap: Optional[Tuple[int, np.ndarray]] = None
+
+    def fid_snapshot(self) -> np.ndarray:
+        """Immutable row→fid mapping AS OF NOW, for pipelined scan handles.
+
+        remove()/compact() mutate ``_fid_of_row`` in place, so a scan
+        completing after a mutation would otherwise decode bit positions
+        against the post-mutation mapping (wrong/ghost fids). Memoized on
+        ``version``: steady-state scans share one copy (O(1) per scan);
+        each mutation burst pays one table-sized copy on the next scan.
+        The returned array is never written to — mutations go to the live
+        ``_fid_of_row``, and the next snapshot call REPLACES the memo."""
+        snap = self._fid_snap
+        if snap is None or snap[0] != self.version:
+            snap = self._fid_snap = (self.version, self._fid_of_row.copy())
+        return snap[1]
 
     def add(self, topic: str | Sequence[str]) -> int:
         levels = split_levels(topic) if isinstance(topic, str) else list(topic)
@@ -392,7 +409,11 @@ class PartitionedRetainedScanner:
             return ("empty", len(filters))
         out = _retained_scan_combo(dev, tuple(gather_parts), tuple(full_parts),
                                    slab=slab)
-        return ("h", out, metas, order, len(filters), t._fid_of_row)
+        # snapshot the row→fid mapping (memoized per table version):
+        # remove()/compact() mutate _fid_of_row in place, so a pipelined
+        # scan completing after a mutation would decode bit positions
+        # against the post-mutation mapping and return wrong/ghost fids
+        return ("h", out, metas, order, len(filters), t.fid_snapshot())
 
     def scan_complete(self, handle) -> List[np.ndarray]:
         if handle[0] == "empty":

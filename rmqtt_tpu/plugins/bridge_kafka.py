@@ -140,6 +140,13 @@ class BridgeIngressKafkaPlugin(Plugin):
                 log.warning("kafka fetch %s[%s]: %s; retrying", topic, partition, e)
                 await asyncio.sleep(self.reconnect_delay)
                 continue
+            if not records:
+                # a broker honoring fetch's max_wait_ms long-polls for us;
+                # one that answers empty immediately (minimal servers) would
+                # otherwise turn this loop into a full-speed RPC spin that
+                # saturates the event loop
+                await asyncio.sleep(0.05)
+                continue
             for off, _ts, key, value, headers in records:
                 offset = off + 1
                 local = (

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -58,7 +59,12 @@ class MessageStoragePlugin(Plugin):
             (ctx.node_id << 48) + (int(time.time() * 1000) & ((1 << 48) - 1))
         )
         self._unhooks = []
-        # buffered forward-marks (see mark_forwarded)
+        # buffered forward-marks (see mark_forwarded); writers run on the
+        # event loop AND executor threads (network flush, load_unforwarded
+        # mark=True), so the swap/merge in flush_forwarded and every write
+        # must hold the lock or concurrent marks are silently dropped —
+        # and a dropped mark replays as a duplicate QoS1 delivery
+        self._fwd_lock = threading.Lock()
         self._fwd_pending: dict = {}
         self._FWD_FLUSH = int(self.config.get("fwd_flush_batch", 256))
         self._flush_task = None
@@ -93,7 +99,8 @@ class MessageStoragePlugin(Plugin):
         loses at most the buffered marks — worst case a QoS1 duplicate
         replay, which MQTT permits."""
         exp = time.time() + max(self.default_expiry, ttl or 0.0)
-        self._fwd_pending[f"{stored_id}\x00{client_id}"] = exp
+        with self._fwd_lock:
+            self._fwd_pending[f"{stored_id}\x00{client_id}"] = exp
         if len(self._fwd_pending) >= self._FWD_FLUSH:
             if not self._net:
                 self.flush_forwarded()  # embedded: one cheap transaction
@@ -120,20 +127,25 @@ class MessageStoragePlugin(Plugin):
                 loop.run_in_executor(None, _bg)
 
     def flush_forwarded(self) -> None:
-        """Drain the buffered forward-marks in one transaction. On a write
-        failure the batch goes BACK into the buffer (newer marks win) so a
-        transient sqlite error costs a retry, not a duplicate replay."""
-        if not self._fwd_pending:
-            return
-        pending, self._fwd_pending = self._fwd_pending, {}
-        try:
-            self.store.put_many_expire(
-                NS_FWD, [(k, True, exp) for k, exp in pending.items()]
-            )
-        except Exception:
-            pending.update(self._fwd_pending)
-            self._fwd_pending = pending
-            raise
+        """Drain the buffered forward-marks in one transaction. Marks stay
+        VISIBLE in the buffer until the store write has committed — a
+        swap-then-write would open a window where a mark is in neither the
+        buffer nor the store and a concurrent ``_was_forwarded`` replays a
+        duplicate. On a write failure the buffer is simply untouched
+        (retry next tick); on success exactly the written marks are
+        dropped (same-key marks re-buffered mid-write keep their newer
+        expiry)."""
+        with self._fwd_lock:
+            if not self._fwd_pending:
+                return
+            pending = dict(self._fwd_pending)
+        self.store.put_many_expire(
+            NS_FWD, [(k, True, exp) for k, exp in pending.items()]
+        )
+        with self._fwd_lock:
+            for k, exp in pending.items():
+                if self._fwd_pending.get(k) == exp:
+                    del self._fwd_pending[k]
 
     def _was_forwarded(self, stored_id, client_id: str) -> bool:
         key = f"{stored_id}\x00{client_id}"

@@ -67,14 +67,14 @@ SharedChoiceFn = Callable[[str, str, List[Tuple[Id, SubscriptionOptions, bool]]]
 def round_robin_choice_factory() -> SharedChoiceFn:
     """Default shared-sub strategy: round-robin over online candidates
     (reference rmqtt/src/subscribe.rs:98-107 default impl)."""
-    counters: Dict[str, int] = {}
+    counters: Dict[Tuple[str, str], int] = {}
 
     def choice(group: str, topic_filter: str, candidates):
         online = [i for i, (_, _, is_on) in enumerate(candidates) if is_on]
         pool = online or list(range(len(candidates)))
         if not pool:
             return None
-        key = f"{group}\x00{topic_filter}"
+        key = (group, topic_filter)  # tuple key: no per-publish f-string
         n = counters.get(key, 0)
         counters[key] = n + 1
         return pool[n % len(pool)]
@@ -89,6 +89,28 @@ class Router(abc.ABC):
     # dispatches small batches inline instead of paying a thread-pool hop;
     # device-backed routers leave this False (their kernels block)
     prefer_inline: bool = False
+
+    # True ONLY for routers whose add()/remove() bump ``epochs`` on every
+    # mutation — the bundled trie/native/xla routers do. RoutingService
+    # keys its match cache on THIS flag, not on ``epochs`` existing (the
+    # lazy property below makes that non-None for every subclass): a
+    # custom router that never bumps would otherwise serve stale entries
+    # forever. Subclasses honoring the contract opt in explicitly.
+    epochs_tracked: bool = False
+
+    @property
+    def epochs(self):
+        """Subscription-table epochs (router/cache.py): every ``add()`` /
+        successful ``remove()`` must bump them so the match-result cache in
+        front of this router can validate entries — and the subclass must
+        set ``epochs_tracked = True`` to enable that cache. Lazy so routers
+        without a cache pay nothing."""
+        ep = getattr(self, "_sub_epochs", None)
+        if ep is None:
+            from rmqtt_tpu.router.cache import SubscriptionEpochs
+
+            ep = self._sub_epochs = SubscriptionEpochs()
+        return ep
 
     def inline_ok(self, batch_size: int) -> bool:
         """May this batch run on the event loop (µs-scale, non-blocking)?

@@ -26,6 +26,7 @@ from rmqtt_tpu.router.relations import RelationsMap, expand_matches_raw
 
 class DefaultRouter(Router):
     prefer_inline = True  # trie match is µs-scale: no executor hop needed
+    epochs_tracked = True  # add/remove bump the match-cache epochs
 
     def __init__(
         self,
@@ -40,11 +41,18 @@ class DefaultRouter(Router):
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
         if self._relations.add(topic_filter, id, opts):
             self._trie.insert(topic_filter, topic_filter)
+        # any REAL relations mutation versions the match cache (the cache
+        # holds expansions, so opts changes count too) — but an identical
+        # re-subscribe (reconnect storms) must not trash hot entries
+        if self._relations.last_add_changed:
+            self.epochs.bump(topic_filter)
 
     def remove(self, topic_filter: str, id: Id) -> bool:
         existed, empty = self._relations.remove(topic_filter, id)
         if empty:
             self._trie.remove(topic_filter, topic_filter)
+        if existed:
+            self.epochs.bump(topic_filter)
         return existed
 
     def matches_raw(self, from_id: Optional[Id], topic: str):

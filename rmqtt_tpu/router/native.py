@@ -25,6 +25,7 @@ from rmqtt_tpu.runtime import NativeTrie
 
 class NativeRouter(Router):
     prefer_inline = True  # C++ trie match is µs-scale: no executor hop
+    epochs_tracked = True  # add/remove bump the match-cache epochs
 
     def __init__(
         self,
@@ -46,6 +47,11 @@ class NativeRouter(Router):
             self._filter_by_vid[vid] = topic_filter
             self._vid_by_filter[topic_filter] = vid
             self._trie.add(topic_filter, vid)
+        # a real relations change versions the match cache even when the
+        # filter already existed (opts changes count: the cache holds
+        # expansions) — identical re-subscribes don't bump
+        if self._relations.last_add_changed:
+            self.epochs.bump(topic_filter)
 
     def remove(self, topic_filter: str, id: Id) -> bool:
         existed, empty = self._relations.remove(topic_filter, id)
@@ -53,6 +59,8 @@ class NativeRouter(Router):
             vid = self._vid_by_filter.pop(topic_filter)
             del self._filter_by_vid[vid]
             self._trie.remove(topic_filter, vid)
+        if existed:
+            self.epochs.bump(topic_filter)
         return existed
 
     def matches_raw(self, from_id: Optional[Id], topic: str):

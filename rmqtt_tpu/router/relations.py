@@ -42,6 +42,13 @@ class RelationsMap:
             else:
                 self.shared_index[key] = n
 
+    # one-call-back state for Router.add: did the last add() actually
+    # mutate the relation (new edge, or same client with different
+    # Id/opts)? An identical re-subscribe — reconnect storms re-subscribing
+    # defensively — must NOT version the match cache, or hot-segment
+    # entries are invalidated on every reconnect with no routing change.
+    last_add_changed: bool = True
+
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> bool:
         """Returns True if the filter is new (needs matcher insertion)."""
         rels = self._map.get(topic_filter)
@@ -56,6 +63,7 @@ class RelationsMap:
         if opts.shared_group:
             key = (opts.shared_group, topic_filter)
             self.shared_index[key] = self.shared_index.get(key, 0) + 1
+        self.last_add_changed = prev is None or prev != (id, opts)
         rels[id.client_id] = (id, opts)
         return is_new
 

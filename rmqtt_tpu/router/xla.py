@@ -48,6 +48,8 @@ class _TreeSide:
 
 
 class XlaRouter(Router):
+    epochs_tracked = True  # add/remove bump the match-cache epochs
+
     def __init__(
         self,
         shared_choice: Optional[SharedChoiceFn] = None,
@@ -158,6 +160,11 @@ class XlaRouter(Router):
                     self._hybrid.side = None
                 else:
                     self._side.add(topic_filter, fid)
+        # version the match cache on real relations mutations (router base
+        # epochs seam), not just device-table inserts; identical
+        # re-subscribes don't bump
+        if self._relations.last_add_changed:
+            self.epochs.bump(topic_filter)
 
     def remove(self, topic_filter: str, id: Id) -> bool:
         existed, empty = self._relations.remove(topic_filter, id)
@@ -167,6 +174,8 @@ class XlaRouter(Router):
             self.table.remove(fid)
             if self._side is not None:
                 self._side.remove(topic_filter, fid)
+        if existed:
+            self.epochs.bump(topic_filter)
         return existed
 
     def inline_ok(self, batch_size: int) -> bool:

@@ -46,6 +46,23 @@ struct CacheEntry {
   int32_t gid;  // stable per-entry group id (dedup key for uploads)
 };
 
+// Heterogeneous unordered lookup (P1690) only ships in libstdc++ from GCC
+// 11; on older toolchains fall back to one reusable thread_local buffer so
+// the hot loop still never allocates per lookup.
+#if defined(__cpp_lib_generic_unordered_lookup)
+template <class Map>
+auto sv_find(const Map& m, std::string_view k) {
+  return m.find(k);
+}
+#else
+template <class Map>
+auto sv_find(const Map& m, std::string_view k) {
+  static thread_local std::string buf;
+  buf.assign(k.data(), k.size());
+  return m.find(buf);
+}
+#endif
+
 struct Encoder {
   std::unordered_map<std::string, int32_t, SvHash, SvEq> tokens;
   // first-(<=3)-level topic prefix -> candidate chunk ids
@@ -116,7 +133,7 @@ int64_t rt_enc_encode(void* h, const char* blob, int64_t n, int32_t max_levels,
     for (;; ++p) {
       if (*p == '/' || *p == '\0') {
         if (nlev < max_levels) {
-          auto it = tokens.find(
+          auto it = sv_find(tokens,
               std::string_view(lev_start, static_cast<size_t>(p - lev_start)));
           row[nlev] = it == tokens.end() ? kUnkTok : it->second;
         }
@@ -129,7 +146,7 @@ int64_t rt_enc_encode(void* h, const char* blob, int64_t n, int32_t max_levels,
     tlen[j] = nlev;
     tdollar[j] = topic_start[0] == '$' ? 1 : 0;
     std::string_view topic(topic_start, static_cast<size_t>(p - topic_start));
-    auto it = cache.find(prefix_key(topic));
+    auto it = sv_find(cache, prefix_key(topic));
     if (it == cache.end()) {
       cand_counts[j] = -1;
       group[j] = -1;

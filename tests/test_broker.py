@@ -525,8 +525,10 @@ async def test_routing_service_stats_surface(broker):
     sub = await connect(broker, "rstat-sub")
     await sub.subscribe("rs/#", qos=1)
     pub = await connect(broker, "rstat-pub")
+    # distinct topics: repeat-topic publishes are served by the match cache
+    # and never reach the batcher (see the cache assertions below)
     for i in range(5):
-        await pub.publish("rs/t", str(i).encode(), qos=1)
+        await pub.publish(f"rs/t{i}", str(i).encode(), qos=1)
     for _ in range(5):
         await sub.recv()
     st = broker.ctx.stats().to_json()
@@ -534,6 +536,16 @@ async def test_routing_service_stats_surface(broker):
     assert st["routing_dispatched_items"] >= 5
     assert st["routing_batch_size_ema"] >= 1
     assert "routing_queued" in st and "routing_inflight_batches" in st
+    # repeat publishes to one topic hit the epoch-versioned match cache
+    dispatches = broker.ctx.routing.dispatches
+    for i in range(4):
+        await pub.publish("rs/t0", b"again", qos=1)
+    for _ in range(4):
+        await sub.recv()
+    st = broker.ctx.stats().to_json()
+    assert st["routing_cache_hits"] >= 3
+    assert st["routing_cache_misses"] >= 1
+    assert broker.ctx.routing.dispatches <= dispatches + 1
 
 
 @broker_test

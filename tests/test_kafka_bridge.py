@@ -258,7 +258,14 @@ def test_kafka_bridge_ingress_and_egress():
             await sub.disconnect_clean()
             await pub.disconnect_clean()
         finally:
-            await b.stop()
-            await fake.stop()
+            # bounded: a wedged stop (e.g. 3.10's Server.wait_closed with a
+            # live handler) must fail the test, not hang the whole suite —
+            # an unbounded await here sits after the outer wait_for's
+            # cancel, where no timer will ever interrupt it. Nested so a
+            # broker-stop timeout still stops the fake server.
+            try:
+                await asyncio.wait_for(b.stop(), 10)
+            finally:
+                await asyncio.wait_for(fake.stop(), 10)
 
     asyncio.run(asyncio.wait_for(run(), 45))

@@ -98,6 +98,30 @@ def test_partitioned_retained_pipelined():
             assert sorted(matched.tolist()) == _scan_expect(rows, f)
 
 
+def test_partitioned_retained_pipelined_scan_survives_mutation():
+    """A scan submitted BEFORE remove()/compact() must decode against the
+    submit-time row→fid mapping, not the post-mutation one (the handle
+    carries a version-memoized snapshot of _fid_of_row)."""
+    rng = random.Random(53)
+    table, rows = _rand_store(rng, n=400)
+    scanner = PartitionedRetainedScanner(table)
+    filters = _rand_filters(rng, 16) + ["#"]
+    expect = {f: _scan_expect(rows, f) for f in filters}
+    h = scanner.scan_submit(filters)
+    # mutate in flight: remove rows and compact (rewrites _fid_of_row)
+    for fid in rng.sample(sorted(rows), len(rows) // 2):
+        table.remove(fid)
+    table.compact()
+    got = scanner.scan_complete(h)
+    for f, matched in zip(filters, got):
+        assert sorted(matched.tolist()) == expect[f], f"filter={f!r}"
+    # steady state: repeated submits share one memoized snapshot
+    s1 = table.fid_snapshot()
+    assert table.fid_snapshot() is s1
+    table.add("fresh/topic/a")
+    assert table.fid_snapshot() is not s1  # mutation re-snapshots
+
+
 def test_partitioned_retained_churn_and_compact():
     rng = random.Random(41)
     table, rows = _rand_store(rng, n=600)

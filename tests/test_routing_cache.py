@@ -1,0 +1,395 @@
+"""Epoch-versioned match-result cache (router/cache.py + RoutingService).
+
+The load-bearing guarantee is ZERO stale results: a cache-on router must be
+indistinguishable from the cache-off ``DefaultRouter`` oracle across
+arbitrary subscribe/unsubscribe/publish interleavings — including v5
+No-Local, ``$share`` groups (round-robin choice still rotates per publish on
+cache hits) and wildcard churn. The property test drives ~10k random ops
+against a twin-router pair; the unit tests pin the invalidation rules
+(segment vs wildcard epochs), LRU eviction, and the RoutingService stats
+surface tier-1 depends on.
+"""
+
+import asyncio
+import random
+
+from rmqtt_tpu.broker.routing import RoutingService
+from rmqtt_tpu.router.base import Id, SubscriptionOptions
+from rmqtt_tpu.router.cache import MatchCache, cached_matches_raw
+from rmqtt_tpu.router.default import DefaultRouter
+
+
+def _norm(relmap):
+    """Order-insensitive canonical form of a SubRelationsMap."""
+    return sorted(
+        (nid, sorted((r.topic_filter, r.id.client_id, r.opts.qos,
+                      r.opts.no_local, r.opts.shared_group) for r in rels))
+        for nid, rels in relmap.items() if rels
+    )
+
+
+# ------------------------------------------------------------------ property
+
+
+def test_property_cache_identical_to_oracle():
+    """~10k random subscribe/unsubscribe/publish ops (exact, +, #, $share,
+    No-Local): every publish routed through the cache must equal the
+    cache-off oracle byte-for-byte. Small capacity forces evictions; the op
+    mix forces segment AND wildcard invalidations mid-stream."""
+    rng = random.Random(7)
+    oracle = DefaultRouter()
+    cached = DefaultRouter()
+    cache = MatchCache(cached.epochs, capacity=64)
+    clients = [f"c{i}" for i in range(40)]
+    segs = ["sensor", "actuator", "home", "plant"]
+
+    def rand_filter():
+        depth = rng.randint(1, 4)
+        levels = [rng.choice(segs) if d == 0 else f"n{rng.randrange(6)}"
+                  for d in range(depth)]
+        r = rng.random()
+        if r < 0.25:
+            levels[rng.randrange(depth)] = "+"
+        if r < 0.12:
+            levels[-1] = "#"
+        return "/".join(levels)
+
+    def rand_topic():
+        depth = rng.randint(1, 4)
+        return "/".join([rng.choice(segs)]
+                        + [f"n{rng.randrange(6)}" for _ in range(depth - 1)])
+
+    live = []
+    publishes = 0
+    for _op in range(10_000):
+        r = rng.random()
+        if r < 0.33:
+            f = rand_filter()
+            sid = Id(1, rng.choice(clients))
+            opts = SubscriptionOptions(
+                qos=rng.randrange(3),
+                no_local=rng.random() < 0.15,
+                shared_group=(f"g{rng.randrange(3)}"
+                              if rng.random() < 0.2 else None),
+            )
+            oracle.add(f, sid, opts)
+            cached.add(f, sid, opts)
+            live.append((f, sid))
+        elif r < 0.45 and live:
+            f, sid = live.pop(rng.randrange(len(live)))
+            assert oracle.remove(f, sid) == cached.remove(f, sid)
+        else:
+            topic = rand_topic()
+            from_id = Id(1, rng.choice(clients)) if rng.random() < 0.5 else None
+            want = oracle.matches(from_id, topic)
+            got = cached.collapse(cached_matches_raw(cached, cache, from_id, topic))
+            assert _norm(got) == _norm(want), (topic, from_id)
+            publishes += 1
+    # the run must actually have exercised every cache code path
+    assert publishes > 1000
+    assert cache.hits > 0 and cache.misses > 0
+    assert cache.invalidations > 0 and cache.evictions > 0
+
+
+def test_shared_round_robin_rotates_on_cache_hits():
+    """Shared-group choice stays per-publish: cache hits must rotate the
+    round-robin pointer exactly like uncached matches do."""
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16)
+    opts = SubscriptionOptions(shared_group="g")
+    for cid in ("a", "b", "c"):
+        router.add("s/t", Id(1, cid), opts)
+    seen = []
+    for _ in range(6):
+        relmap = router.collapse(cached_matches_raw(router, cache, None, "s/t"))
+        (rel,) = relmap[1]
+        seen.append(rel.id.client_id)
+    # publish 1 missed (doorkeeper), publish 2 missed (admitted+stored),
+    # 3-6 hit — and the choice rotated on every publish regardless
+    assert cache.hits == 4
+    assert seen == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_no_local_derived_per_publisher():
+    """One cached entry serves different publishers correctly: the No-Local
+    relation is filtered only for the subscribing client's own publishes."""
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16, admission=False)
+    router.add("a/b", Id(1, "me"), SubscriptionOptions(no_local=True))
+    router.add("a/b", Id(1, "you"), SubscriptionOptions())
+    full = router.collapse(cached_matches_raw(router, cache, Id(1, "other"), "a/b"))
+    assert sorted(r.id.client_id for r in full[1]) == ["me", "you"]
+    own = router.collapse(cached_matches_raw(router, cache, Id(1, "me"), "a/b"))
+    assert [r.id.client_id for r in own[1]] == ["you"]
+    assert cache.hits == 1  # the second publish was served from the entry
+
+
+# --------------------------------------------------------------- invalidation
+
+
+def test_segment_epoch_invalidation_is_scoped():
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16, admission=False)
+    router.add("sensor/1/temp", Id(1, "a"), SubscriptionOptions())
+    cached_matches_raw(router, cache, None, "sensor/1/temp")  # miss + store
+    assert cache.get("sensor/1/temp") is not None
+    # an exact filter under a DIFFERENT first segment leaves the entry alone
+    router.add("other/x", Id(1, "b"), SubscriptionOptions())
+    assert cache.get("sensor/1/temp") is not None
+    # same-segment churn invalidates (even a different filter: conservative)
+    router.add("sensor/2/hum", Id(1, "c"), SubscriptionOptions())
+    assert cache.get("sensor/1/temp") is None
+    assert cache.invalidations == 1
+    # unsubscribe bumps too
+    cached_matches_raw(router, cache, None, "sensor/1/temp")
+    router.remove("sensor/2/hum", Id(1, "c"))
+    assert cache.get("sensor/1/temp") is None
+
+
+def test_identical_resubscribe_does_not_invalidate():
+    """Reconnect storms re-subscribe defensively with identical opts — that
+    must not version the cache (no routing change); a real opts change
+    still does."""
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16, admission=False)
+    opts = SubscriptionOptions(qos=1)
+    router.add("sensor/1", Id(1, "a"), opts)
+    cached_matches_raw(router, cache, None, "sensor/1")
+    router.add("sensor/1", Id(1, "a"), SubscriptionOptions(qos=1))  # identical
+    assert cache.get("sensor/1") is not None  # still valid
+    router.add("sensor/1", Id(1, "a"), SubscriptionOptions(qos=2))  # changed
+    assert cache.get("sensor/1") is None
+    assert cache.invalidations == 1
+
+
+def test_wildcard_epoch_invalidates_globally():
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16, admission=False)
+    router.add("sensor/1", Id(1, "a"), SubscriptionOptions())
+    cached_matches_raw(router, cache, None, "sensor/1")
+    cached_matches_raw(router, cache, None, "unrelated/topic")
+    # a wildcard filter may match anything → every entry is stale
+    router.add("sensor/+/temp", Id(1, "b"), SubscriptionOptions())
+    assert cache.get("sensor/1") is None
+    assert cache.get("unrelated/topic") is None
+    assert cache.invalidations == 2
+
+
+def test_segment_epoch_overflow_folds_into_wildcard():
+    """The per-segment epoch map is bounded (first levels are
+    attacker-chosen): overflowing SEG_CAP folds into the global wildcard
+    epoch, which invalidates everything — conservative, never stale."""
+    from rmqtt_tpu.router.cache import SubscriptionEpochs
+
+    old_cap = SubscriptionEpochs.SEG_CAP
+    SubscriptionEpochs.SEG_CAP = 4
+    try:
+        router = DefaultRouter()
+        cache = MatchCache(router.epochs, capacity=16, admission=False)
+        for i in range(4):
+            router.add(f"s{i}/t", Id(1, "a"), SubscriptionOptions())
+        cached_matches_raw(router, cache, None, "s0/t")
+        assert cache.get("s0/t") is not None
+        wild = router.epochs.wild
+        router.add("brand-new-seg/t", Id(1, "a"), SubscriptionOptions())
+        assert router.epochs.wild == wild + 1  # folded
+        assert len(router.epochs._seg) == 1  # cleared, then the new segment
+        assert cache.get("s0/t") is None  # every entry invalidated
+    finally:
+        SubscriptionEpochs.SEG_CAP = old_cap
+
+
+def test_negative_results_cached_and_invalidated():
+    """Publishes to unsubscribed topics cache their empty result — and a
+    later matching subscribe must invalidate it."""
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16)
+    for _ in range(3):  # miss (doorkeeper), miss (stored), hit
+        assert router.collapse(cached_matches_raw(router, cache, None, "a/b")) == {}
+    assert cache.hits == 1
+    router.add("a/b", Id(1, "s"), SubscriptionOptions())
+    relmap = router.collapse(cached_matches_raw(router, cache, None, "a/b"))
+    assert [r.id.client_id for r in relmap[1]] == ["s"]
+
+
+def test_doorkeeper_admission():
+    """A topic is stored on its SECOND miss (one-shot topics never churn
+    the LRU); an invalidated hot topic re-admits after ONE miss."""
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16)
+    router.add("a/b", Id(1, "s"), SubscriptionOptions())
+    cached_matches_raw(router, cache, None, "a/b")
+    assert len(cache) == 0 and cache.door_rejects == 1  # first miss: rejected
+    cached_matches_raw(router, cache, None, "a/b")
+    assert len(cache) == 1  # second miss: stored
+    assert cache.get("a/b") is not None
+    # invalidate by same-segment churn; one miss re-admits
+    router.add("a/c", Id(1, "t"), SubscriptionOptions())
+    misses = cache.misses
+    cached_matches_raw(router, cache, None, "a/b")
+    assert cache.misses == misses + 1 and cache.get("a/b") is not None
+
+
+def test_lru_eviction():
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=2, admission=False)
+    for t in ("t/1", "t/2", "t/3"):
+        cached_matches_raw(router, cache, None, t)
+    assert len(cache) == 2 and cache.evictions == 1
+    misses = cache.misses
+    assert cache.get("t/1") is None  # the oldest entry was evicted
+    assert cache.get("t/3") is not None
+    assert cache.misses == misses + 1
+
+
+def test_shared_bypass_serves_but_does_not_store():
+    router = DefaultRouter()
+    cache = MatchCache(router.epochs, capacity=16, shared_bypass=True,
+                       admission=False)
+    router.add("s/t", Id(1, "a"), SubscriptionOptions(shared_group="g"))
+    router.add("p/t", Id(1, "b"), SubscriptionOptions())
+    relmap = router.collapse(cached_matches_raw(router, cache, None, "s/t"))
+    assert relmap[1][0].id.client_id == "a"  # bypassed entry still serves
+    assert cache.get("s/t") is None  # ...but was not stored
+    cached_matches_raw(router, cache, None, "p/t")
+    assert cache.get("p/t") is not None  # non-shared topics still cache
+
+
+# ------------------------------------------------------------ RoutingService
+
+
+def test_routing_service_cache_stats_gauges():
+    """Smoke: RoutingService.stats() exposes the cache observability surface
+    (tier-1 pins these keys for /stats and the dashboard)."""
+    async def go():
+        router = DefaultRouter()
+        router.add("a/b", Id(1, "s"), SubscriptionOptions())
+        svc = RoutingService(router)
+        svc.start()
+        try:
+            m1 = await svc.matches(None, "a/b")  # miss (doorkeeper)
+            await svc.matches(None, "a/b")  # miss (admitted + stored)
+            m2, hit = await svc.matches_for_fanout(None, "a/b")
+            assert _norm(m1) == _norm(m2) and hit
+            st = svc.stats()
+            for key in ("routing_cache_size", "routing_cache_hits",
+                        "routing_cache_misses", "routing_cache_invalidations",
+                        "routing_cache_evictions",
+                        "routing_cache_door_rejects"):
+                assert key in st, key
+            assert st["routing_cache_hits"] >= 1
+            assert st["routing_cache_misses"] >= 2
+            assert st["routing_cache_size"] == 1
+        finally:
+            await svc.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_cache_requires_epoch_opt_in():
+    """A custom Router subclass that never bumps epochs must run uncached —
+    the base-class epochs property alone is not proof of the contract."""
+    class CustomRouter(DefaultRouter):
+        epochs_tracked = False  # e.g. a third-party router via ctx.router
+
+    svc = RoutingService(CustomRouter())
+    assert svc.cache is None
+    assert RoutingService(DefaultRouter()).cache is not None
+
+
+def test_routing_service_cache_disabled():
+    async def go():
+        router = DefaultRouter()
+        router.add("a/b", Id(1, "s"), SubscriptionOptions())
+        svc = RoutingService(router, cache_enable=False)
+        assert svc.cache is None
+        svc.start()
+        try:
+            for _ in range(3):
+                relmap = await svc.matches(None, "a/b")
+                assert [r.id.client_id for r in relmap[1]] == ["s"]
+            st = svc.stats()
+            assert st["routing_cache_hits"] == 0 and st["routing_cache_size"] == 0
+            assert svc.dispatches == 3  # every publish reached the batcher
+        finally:
+            await svc.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_routing_service_batch_dedup_and_raw_waiters():
+    """Queued misses to one hot topic collapse to ONE match per dispatch;
+    collapsed and raw waiters both derive from the shared entry."""
+    class CountingRouter(DefaultRouter):
+        def __init__(self):
+            super().__init__()
+            self.match_items = 0
+
+        def matches_batch_raw(self, items):
+            self.match_items += len(items)
+            return super().matches_batch_raw(items)
+
+    async def go():
+        router = CountingRouter()
+        router.add("hot/t", Id(1, "s"), SubscriptionOptions())
+        svc = RoutingService(router)
+        # park 8 publishes for the same topic BEFORE the drain task starts,
+        # so they arrive as one batch
+        futs = [asyncio.get_running_loop().create_future() for _ in range(8)]
+        for i, fut in enumerate(futs):
+            await svc._q.put((None, "hot/t", fut, i % 2 == 1))
+        svc.start()
+        try:
+            results = await asyncio.gather(*futs)
+            assert router.match_items == 1, "batch must dedup repeat topics"
+            for i, res in enumerate(results):
+                if i % 2 == 1:  # raw waiter: (out, shared) pre-collapse
+                    out, shared = res
+                    assert shared == {}
+                    assert [r.id.client_id for r in out[1]] == ["s"]
+                else:
+                    assert [r.id.client_id for r in res[1]] == ["s"]
+        finally:
+            await svc.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_conf_routing_section(tmp_path):
+    from rmqtt_tpu import conf
+
+    cfgf = tmp_path / "r.toml"
+    cfgf.write_text(
+        "[listener]\nport = 1883\n"
+        "[routing]\ncache = false\ncache_capacity = 128\n"
+        "cache_shared_bypass = true\nbatch_max = 256\nlinger_ms = 1.5\n"
+        "pipeline_depth = 2\n"
+    )
+    s = conf.load(str(cfgf))
+    assert s.broker.route_cache is False
+    assert s.broker.route_cache_capacity == 128
+    assert s.broker.route_cache_shared_bypass is True
+    assert s.broker.batch_max == 256
+    assert s.broker.batch_linger_ms == 1.5
+    assert s.broker.routing_pipeline_depth == 2
+    # env override reaches the section like every other one
+    s2 = conf.load(str(cfgf), environ={"RMQTT_ROUTING__CACHE": "true"})
+    assert s2.broker.route_cache is True
+    # unknown keys fail fast
+    bad = tmp_path / "bad.toml"
+    bad.write_text("[routing]\ncache_sz = 1\n")
+    try:
+        conf.load(str(bad))
+        raise AssertionError("unknown [routing] key must raise")
+    except ValueError as e:
+        assert "cache_sz" in str(e)
+
+
+def test_stats_class_declares_cache_gauges():
+    from rmqtt_tpu.broker.metrics import Stats
+
+    j = Stats().to_json()
+    for key in ("routing_cache_size", "routing_cache_hits",
+                "routing_cache_misses", "routing_cache_invalidations",
+                "routing_cache_evictions", "routing_cache_door_rejects"):
+        assert key in j, key

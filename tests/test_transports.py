@@ -542,6 +542,30 @@ def test_conf_log_section(tmp_path):
         root.setLevel(logging.WARNING)
 
 
+def test_conf_log_file_sink_without_filename_stays_silent(capsys):
+    """to="file" with an empty filename used to add no handler while still
+    setting the root level — WARNING+ then leaked to stderr through
+    logging.lastResort. A NullHandler must pin the silence."""
+    import logging
+
+    from rmqtt_tpu import conf
+
+    prior = list(logging.getLogger().handlers)
+    try:
+        conf.setup_logging(conf.LogConfig(to="file", file=""))
+        root = logging.getLogger()
+        assert any(isinstance(h, logging.NullHandler) for h in root.handlers)
+        logging.getLogger("x").warning("must-not-leak-to-stderr")
+        assert "must-not-leak-to-stderr" not in capsys.readouterr().err
+    finally:
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in prior:
+            root.addHandler(h)
+        root.setLevel(logging.WARNING)
+
+
 def test_conf_log_defaults_and_errors(tmp_path):
     from rmqtt_tpu import conf
 
