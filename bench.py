@@ -685,6 +685,172 @@ def run_cache_config(name, rng, reduced):
     return res
 
 
+def run_telemetry_config(name, rng, reduced):
+    """Config 7: latency-telemetry overhead (broker/telemetry.py) on the
+    REAL publish path.
+
+    Runs an in-process broker (real sockets, real sessions, the deployed
+    RoutingService + match cache) with one QoS0 publisher → one subscriber
+    over a rotating topic set, telemetry OFF vs ON in interleaved windows,
+    and reports the throughput delta. This is the path every telemetry
+    stage actually instruments — a stripped router-only loop triples the
+    apparent relative cost because it deletes most of the per-publish work
+    the substrate's ~1-2µs rides on. The enabled windows' p50/p99 for
+    publish e2e and the match stage ride into the bench JSON so
+    BENCH_*.json rounds carry a latency trajectory, not just throughput.
+
+    Also reports the raw substrate cost per op (tight-loop microbench of
+    one clock pair + one recorder call) for transparency."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.server import MqttBroker
+    from rmqtt_tpu.broker.telemetry import Telemetry
+
+    msgs = 6_000 if reduced else 15_000
+    ntopics = 64  # rotating topics: exercises both cache-hit and miss paths
+    payload = b"x" * 64
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _pipe(enable):
+        """Broker + 1 subscriber + 1 publisher; → (burst fn, broker)."""
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, telemetry_enable=enable, allow_anonymous=True)))
+        await b.start()
+        sr, sw, scodec = await _connect(b.port, f"c7-sub-{enable}")
+        sw.write(scodec.encode(pk.Subscribe(1, [("bench/#", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        await _read_until(sr, scodec, pk.Suback)
+        _pr, pw, pcodec = await _connect(b.port, f"c7-pub-{enable}")
+        frames = [pcodec.encode(pk.Publish(
+            topic=f"bench/t{i}", payload=payload, qos=0))
+            for i in range(ntopics)]
+
+        async def burst(n):
+            """Blast n publishes, drain n deliveries; → elapsed seconds."""
+            t0 = time.perf_counter()
+            sent = 0
+            got = 0
+            deadline = time.monotonic() + 60.0
+            while sent < n:
+                k = min(64, n - sent)
+                pw.write(b"".join(
+                    frames[(sent + j) % ntopics] for j in range(k)))
+                sent += k
+                if pw.transport.get_write_buffer_size() > 1 << 18:
+                    await pw.drain()
+                while got < sent - 2048:
+                    data = await asyncio.wait_for(
+                        sr.read(1 << 16), deadline - time.monotonic())
+                    if not data:
+                        raise ConnectionError("subscriber closed")
+                    got += sum(1 for p in scodec.feed(data)
+                               if isinstance(p, pk.Publish))
+            await pw.drain()
+            while got < sent:
+                data = await asyncio.wait_for(
+                    sr.read(1 << 16), deadline - time.monotonic())
+                if not data:
+                    raise ConnectionError("subscriber closed")
+                got += sum(1 for p in scodec.feed(data)
+                           if isinstance(p, pk.Publish))
+            return time.perf_counter() - t0
+
+        return burst, b
+
+    async def _measure():
+        """BOTH brokers live at once; off/on bursts alternate back-to-back
+        so host-load drift on this shared-core machine — far larger than
+        the effect under test across whole-broker windows — hits both
+        conditions equally and cancels in the ratio (the artifact)."""
+        burst_off, b_off = await _pipe(False)
+        burst_on, b_on = await _pipe(True)
+        try:
+            await burst_off(1024)  # warm: codec, cache, deliver path
+            await burst_on(1024)
+            # small bursts = fine-grained pairing: host-load drift on this
+            # shared core moves ±10% between half-second windows, so the
+            # pair must fit well inside one
+            per = 256
+            pairs = []
+            done = 0
+            while done < msgs:
+                # order-symmetric QUAD (off,on,on,off): each condition runs
+                # once in each position, and taking the min of its two
+                # bursts filters one-sided load spikes before the ratio is
+                # formed — the estimator that finally resolves a ~1-2%
+                # effect under this host's ±10% half-second drift
+                t_off1 = await burst_off(per)
+                t_on1 = await burst_on(per)
+                t_on2 = await burst_on(per)
+                t_off2 = await burst_off(per)
+                pairs.append((min(t_off1, t_off2), min(t_on1, t_on2)))
+                done += 2 * per
+            med_ratio = float(np.median([tn / tf for tf, tn in pairs]))
+            best_off = min(tf for tf, _ in pairs)
+            tps_off = per / best_off
+            return tps_off, tps_off / med_ratio, b_on.ctx.telemetry
+        finally:
+            await b_off.stop()
+            await b_on.stop()
+
+    tps_off, tps_on, tele_on = asyncio.run(_measure())
+    overhead = (tps_off - tps_on) / tps_off
+
+    # substrate microbench: one clock pair + one fast-recorder call
+    sub_tele = Telemetry(enabled=True)
+    rec = sub_tele.recorder("publish.e2e")
+    pcns = time.perf_counter_ns
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ts = pcns()
+        rec(pcns() - ts)
+    per_record_ns = (time.perf_counter() - t0) / n * 1e9
+
+    res = {
+        "name": name,
+        "path": "broker_e2e_qos0_pipe",
+        "msgs_per_window": msgs,
+        "msgs_per_sec_off": round(tps_off, 1),
+        "msgs_per_sec_on": round(tps_on, 1),
+        # may be slightly negative (noise floor); the bound is one-sided
+        "overhead_pct": round(100.0 * overhead, 2),
+        "target_overhead_pct": 3.0,
+        "substrate_ns_per_record": round(per_record_ns, 1),
+        "latency_ms": {
+            "match_p50": tele_on.p_ms("routing.match", 0.50),
+            "match_p99": tele_on.p_ms("routing.match", 0.99),
+            "e2e_p50": tele_on.p_ms("publish.e2e", 0.50),
+            "e2e_p99": tele_on.p_ms("publish.e2e", 0.99),
+        },
+        "samples": tele_on.hist("publish.e2e").count,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] broker pipe: off {tps_off:.0f} vs on {tps_on:.0f} msg/s "
+        f"→ overhead {res['overhead_pct']:.2f}% "
+        f"(substrate {per_record_ns:.0f}ns/record) | e2e p50 "
+        f"{res['latency_ms']['e2e_p50']}ms p99 {res['latency_ms']['e2e_p99']}ms")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -748,10 +914,11 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 6
+            return i <= 7
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
-        # host-side match-result cache) is cheap and always informative
-        return i <= 3 or i == 6 or args.full or on_tpu
+        # host-side match-result cache) and cfg7 (telemetry overhead) are
+        # cheap and always informative
+        return i <= 3 or i in (6, 7) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -842,9 +1009,29 @@ def main():
 
         guarded("cfg6_cache_zipf", cfg6)
 
-    # cfg6 has its own shape (cache on/off, no tpu/cpu variants): it rides
-    # the artifact under "route_cache" instead of the configs table
+    if want(7):
+        def cfg7():
+            return run_telemetry_config("cfg7_telemetry_overhead", rng, reduced)
+
+        guarded("cfg7_telemetry_overhead", cfg7)
+
+    # cfg6/cfg7 have their own shapes (on/off comparisons, no tpu/cpu
+    # variants): they ride the artifact under "route_cache" /
+    # "telemetry_overhead" instead of the configs table
     cache_res = results.pop("cfg6_cache_zipf", None)
+    tele_res = results.pop("cfg7_telemetry_overhead", None)
+    if not results and tele_res is not None and cache_res is None:
+        print(json.dumps({
+            "metric": "telemetry_overhead_pct[cfg7_telemetry_overhead]",
+            "value": tele_res["overhead_pct"],
+            "unit": "pct_vs_off",
+            "vs_baseline": tele_res["overhead_pct"],
+            "platform": platform,
+            "latency_ms": tele_res["latency_ms"],
+            "telemetry_overhead": tele_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        return
     if not results and cache_res is not None:
         print(json.dumps({
             "metric": "route_cache_speedup[cfg6_cache_zipf]",
@@ -854,6 +1041,7 @@ def main():
             "hit_rate": cache_res["zipf"]["cached"].get("hit_rate"),
             "platform": platform,
             "route_cache": cache_res,
+            **({"telemetry_overhead": tele_res} if tele_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -919,6 +1107,10 @@ def main():
             for k, v in results.items()
         },
         **({"route_cache": cache_res} if cache_res is not None else {}),
+        # latency trajectory: p50/p99 for match + publish e2e (cfg7's
+        # enabled run) so BENCH rounds track tails, not just throughput
+        **({"telemetry_overhead": tele_res,
+            "latency_ms": tele_res["latency_ms"]} if tele_res is not None else {}),
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }

@@ -95,6 +95,12 @@ class BrokerConfig:
     max_handshaking: int = 2000
     max_handshake_rate: float = 0.0  # 0 = unlimited, else handshakes/sec
     busy_loadavg: float = 0.0  # 0 = ignore; else refuse above load1/ncpu
+    # latency telemetry (broker/telemetry.py, [observability] config
+    # section): log2 stage histograms + slow-op ring. Disabled = the hot
+    # paths never take a timestamp (single-branch guards)
+    telemetry_enable: bool = True
+    telemetry_slow_ms: float = 100.0  # ring-log threshold per op
+    telemetry_slow_log_max: int = 256  # bounded slow-op ring size
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -112,6 +118,13 @@ class ServerContext:
         self.cfg = cfg or BrokerConfig()
         self.hooks = HookRegistry()
         self.metrics = Metrics()
+        from rmqtt_tpu.broker.telemetry import Telemetry
+
+        self.telemetry = Telemetry(
+            enabled=self.cfg.telemetry_enable,
+            slow_ms=self.cfg.telemetry_slow_ms,
+            slow_log_max=self.cfg.telemetry_slow_log_max,
+        )
         # v5 enhanced-auth seam (broker/auth.py); None = AUTH methods refused
         self.enhanced_auth = None
         if router is None:
@@ -133,6 +146,9 @@ class ServerContext:
             else:
                 router = DefaultRouter(is_online=online)
         self.router = router
+        # the router records its kernel.dispatch stage through the shared
+        # registry (router/base.py telemetry seam)
+        router.telemetry = self.telemetry
         self.routing = RoutingService(
             router,
             max_batch=self.cfg.batch_max,
@@ -141,6 +157,7 @@ class ServerContext:
             cache_enable=self.cfg.route_cache,
             cache_capacity=self.cfg.route_cache_capacity,
             cache_shared_bypass=self.cfg.route_cache_shared_bypass,
+            telemetry=self.telemetry,
         )
         self.retain = RetainStore(
             enable=self.cfg.retain_enable,

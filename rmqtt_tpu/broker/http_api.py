@@ -227,6 +227,7 @@ class HttpApi:
                 "/api/v1/routes", "/api/v1/routes/{topic}",
                 "/api/v1/stats", "/api/v1/stats/sum",
                 "/api/v1/metrics", "/api/v1/metrics/sum",
+                "/api/v1/latency", "/api/v1/latency/sum",
                 "/api/v1/plugins", "/api/v1/plugins/{plugin}",
                 "/api/v1/mqtt/publish", "/api/v1/mqtt/subscribe",
                 "/api/v1/mqtt/unsubscribe", "/metrics/prometheus",
@@ -352,11 +353,12 @@ class HttpApi:
                     if isinstance(v, (int, float)):
                         total[k] = total.get(k, 0) + v
             nodes = 1 + len(replies)
-            # *_ema gauges are average-mode (counter.rs StatsMergeMode::Avg),
-            # not summable counts
+            # *_ema and *_ms gauges are average-mode (counter.rs
+            # StatsMergeMode::Avg) — batch-size EMAs and latency
+            # percentiles are never summable counts
             for k in list(total):
-                if k.endswith("_ema") and nodes > 1:
-                    total[k] = round(total[k] / nodes, 1)
+                if (k.endswith("_ema") or k.endswith("_ms")) and nodes > 1:
+                    total[k] = round(total[k] / nodes, 3)
             return 200, {"nodes": nodes, "stats": total}, J
         if path == "/api/v1/stats":
             nodes = [{"node": ctx.node_id, "stats": ctx.stats().to_json()}]
@@ -376,6 +378,21 @@ class HttpApi:
             return 200, {"metrics": total}, J
         if path == "/api/v1/metrics":
             return 200, {"node": ctx.node_id, "metrics": ctx.metrics.to_json()}, J
+        if path == "/api/v1/latency/sum":
+            # cluster-wide latency: per-node log2 histograms merge by
+            # BUCKET-WISE ADDITION (the design property fixed buckets buy —
+            # order statistics from different nodes could never merge)
+            from rmqtt_tpu.broker.telemetry import Telemetry
+            local = ctx.telemetry.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "latency"},
+                lambda r: [r["latency"]] if "latency" in r else [],
+            )
+            return 200, Telemetry.merge_snapshots(local, peers), J
+        if path == "/api/v1/latency":
+            # stage histograms + slow-op ring (broker/telemetry.py);
+            # shape-stable with telemetry disabled (zero-count stages)
+            return 200, {"node": ctx.node_id, **ctx.telemetry.snapshot()}, J
         if path.startswith("/api/v1/plugins/"):
             # single-plugin control (api.rs plugins/{plugin}[/load|/unload|
             # /config/reload])
@@ -472,15 +489,23 @@ class HttpApi:
         }
 
     def _prometheus(self) -> str:
+        from rmqtt_tpu.broker.telemetry import prom_sanitize as sanitize
+
         stats = self.ctx.stats().to_json()
         lines = []
+        labels = f'node="{self.ctx.node_id}"'
         for k, v in stats.items():
-            lines.append(f"# TYPE rmqtt_{k} gauge")
-            lines.append(f'rmqtt_{k}{{node="{self.ctx.node_id}"}} {v}')
+            name = "rmqtt_" + sanitize(k)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{{{labels}}} {v}")
         for k, v in self.ctx.metrics.to_json().items():
-            name = "rmqtt_" + k.replace(".", "_")
+            # monotonic counters take the conventional `_total` suffix
+            # (exposition format: counter sample names end in _total)
+            name = "rmqtt_" + sanitize(k) + "_total"
             lines.append(f"# TYPE {name} counter")
-            lines.append(f'{name}{{node="{self.ctx.node_id}"}} {v}')
+            lines.append(f"{name}{{{labels}}} {v}")
+        # latency stage histograms (_bucket/_sum/_count families)
+        lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         return "\n".join(lines) + "\n"
 
 
@@ -500,6 +525,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 </style></head><body>
 <h1>rmqtt_tpu broker <span id="node"></span></h1><div id="err"></div>
 <div class="cards" id="stats"></div>
+<h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
 <th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
 <th>subs</th><th>queue</th><th>inflight</th></tr></thead><tbody></tbody></table>
@@ -510,7 +536,15 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "topics","routes","retaineds","delayed_publishs","message_queues",
  "out_inflights","in_inflights","handshakings","handshakings_active",
  "handshakings_rate","forwards","message_storages",
- "routing_cache_size","routing_cache_hits","routing_cache_misses"];
+ "routing_cache_size","routing_cache_hits","routing_cache_misses",
+ "routing_cache_invalidations","routing_cache_evictions",
+ "routing_cache_door_rejects"];
+// latency cards: stage -> quantiles shown (fed by /api/v1/latency;
+// histogram units are ns, rendered as ms)
+const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],
+ ["routing.queue_wait",["p50","p99"]],["publish.cache_hit",["p99"]],
+ ["publish.cache_miss",["p99"]],["connect.handshake",["p99"]]];
+const ms=ns=>ns>=1e6?(ns/1e6).toFixed(1)+"ms":(ns/1e3).toFixed(0)+"us";
 async function j(p){const r=await fetch(p);if(!r.ok)throw new Error(p+": "+r.status);return r.json()}
 // client ids / topics / usernames are ATTACKER-CHOSEN (any MQTT client);
 // everything interpolated into markup must be escaped
@@ -531,6 +565,13 @@ async function tick(){
   const subs=await j("/api/v1/subscriptions?_limit=50");
   document.querySelector("#subs tbody").innerHTML=subs.map(s=>
    `<tr><td>${esc(s.client_id)}</td><td>${esc(s.topic_filter)}</td><td>${esc(s.qos)}</td></tr>`).join("");
+  const lat=await j("/api/v1/latency");
+  const hs=lat.histograms||{};
+  document.getElementById("latency").innerHTML=
+   (lat.enabled?"":`<div class="card"><div class="v">off</div><div class="k">telemetry disabled</div></div>`)+
+   LAT_STAGES.map(([st,qs])=>{const h=hs[st];if(!h||!h.count)return "";
+    return qs.map(q=>`<div class="card"><div class="v">${esc(ms(h[q]))}</div>
+     <div class="k">${esc(st)} ${esc(q)} (n=${esc(h.count)})</div></div>`).join("")}).join("");
   document.getElementById("err").textContent="";
  }catch(e){document.getElementById("err").textContent=String(e)}
 }

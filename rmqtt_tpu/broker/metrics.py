@@ -62,6 +62,16 @@ class Stats:
         self.routing_cache_invalidations = 0
         self.routing_cache_evictions = 0
         self.routing_cache_door_rejects = 0
+        # latency percentile gauges (broker/telemetry.py histograms),
+        # overwritten from RoutingService.stats(); the `_ms` suffix marks
+        # average-mode for cluster /stats/sum merging (like `_ema`) —
+        # latency percentiles are never summable across nodes
+        self.routing_match_p50_ms = 0.0
+        self.routing_match_p99_ms = 0.0
+        self.routing_queue_wait_p50_ms = 0.0
+        self.routing_queue_wait_p99_ms = 0.0
+        self.publish_e2e_p50_ms = 0.0
+        self.publish_e2e_p99_ms = 0.0
 
     def to_json(self) -> Dict[str, int]:
         return dict(vars(self))

@@ -29,8 +29,10 @@ re-flagged, round-robin choice still per publish).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import List, Optional, Tuple
 
+from rmqtt_tpu.broker.telemetry import NULL_TELEMETRY, Telemetry
 from rmqtt_tpu.router.base import Id, Router, SubRelationsMap
 from rmqtt_tpu.router.cache import MatchCache
 
@@ -46,8 +48,18 @@ class RoutingService:
         cache_enable: bool = True,
         cache_capacity: int = 8192,
         cache_shared_bypass: bool = False,
+        telemetry: Optional[Telemetry] = None,
     ) -> None:
         self.router = router
+        # latency telemetry (broker/telemetry.py): stage histograms for
+        # queue wait / match / hit-vs-miss + the slow-op ring. The disabled
+        # singleton keeps every hot-path guard a single attribute test;
+        # per-publish stages go through fast recorder closures (no-ops
+        # when disabled — the t0 guards mean they're never even called)
+        self.tele = telemetry if telemetry is not None else NULL_TELEMETRY
+        self._rec_hit = self.tele.recorder("publish.cache_hit")
+        self._rec_miss = self.tele.recorder("publish.cache_miss")
+        self._rec_qwait = self.tele.recorder("routing.queue_wait")
         self.max_batch = max_batch
         self.linger = linger_ms / 1000.0
         self._q: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
@@ -86,9 +98,24 @@ class RoutingService:
     def stats(self) -> dict:
         """Gauges for the admin surface (per-exec stats parity). The _ema
         key is average-mode for cluster merging (counter.rs AVG), not a
-        summable count — /stats/sum treats the suffix accordingly."""
+        summable count — /stats/sum treats the suffix accordingly (as it
+        does the _ms latency-percentile keys below)."""
         c = self.cache
+        t = self.tele
+        t.flush()  # ONE fold pass; the quantile reads below skip theirs
+
+        def pq(name: str, q: float) -> float:
+            return round(t.hist(name).quantile(q) / 1e6, 3)
+
         return {
+            # latency percentile gauges (broker/telemetry.py histograms):
+            # zeros when telemetry is disabled — shape-stable either way
+            "routing_match_p50_ms": pq("routing.match", 0.50),
+            "routing_match_p99_ms": pq("routing.match", 0.99),
+            "routing_queue_wait_p50_ms": pq("routing.queue_wait", 0.50),
+            "routing_queue_wait_p99_ms": pq("routing.queue_wait", 0.99),
+            "publish_e2e_p50_ms": pq("publish.e2e", 0.50),
+            "publish_e2e_p99_ms": pq("publish.e2e", 0.99),
             "routing_queued": self._q.qsize(),
             "routing_inflight_batches": self.inflight,
             "routing_dispatches": self.dispatches,
@@ -125,7 +152,7 @@ class RoutingService:
         # reject everything still parked in either queue — those waiters
         # would otherwise await forever (e.g. forwards() during shutdown)
         while not self._completion_q.empty():
-            batch, _groups, _handle = self._completion_q.get_nowait()
+            batch, _groups, _handle, _t, _n = self._completion_q.get_nowait()
             self._reject(batch, RuntimeError("routing service stopped"))
         while not self._q.empty():
             item = self._q.get_nowait()
@@ -154,23 +181,41 @@ class RoutingService:
         loops and overflow bounded deliver queues (measured: QoS0 drops
         under flood). The hit path preserves that cooperative yield with an
         explicit sleep(0), still far cheaper than the queue round trip."""
+        t0 = time.perf_counter_ns() if self.tele.enabled else 0
         entry = self._cache_lookup(topic)
         if entry is not None:
             await asyncio.sleep(0)
-            return self.router.collapse(self.cache.derive(entry, from_id)), True
+            out = self.router.collapse(self.cache.derive(entry, from_id))
+            if t0:
+                self._rec_hit(time.perf_counter_ns() - t0, topic)
+            return out, True
         fut = asyncio.get_running_loop().create_future()
-        await self._q.put((from_id, topic, fut, False))
-        return await fut, False
+        # t0 doubles as the enqueue timestamp for the queue-wait histogram
+        await self._q.put((from_id, topic, fut, False, t0))
+        res = await fut
+        # only meaningful with the cache on: a cache-off broker recording
+        # every publish as a "miss" would read as a malfunctioning cache
+        # (same rule as the hit/miss counters in shared.forwards)
+        if t0 and self.cache is not None:
+            self._rec_miss(time.perf_counter_ns() - t0, topic)
+        return res, False
 
     async def matches_raw(self, from_id: Optional[Id], topic: str):
         """Un-collapsed variant for cluster-global shared-group choice."""
+        t0 = time.perf_counter_ns() if self.tele.enabled else 0
         entry = self._cache_lookup(topic)
         if entry is not None:
             await asyncio.sleep(0)  # keep the cooperative yield (see above)
-            return self.cache.derive(entry, from_id)
+            out = self.cache.derive(entry, from_id)
+            if t0:
+                self._rec_hit(time.perf_counter_ns() - t0, topic)
+            return out
         fut = asyncio.get_running_loop().create_future()
-        await self._q.put((from_id, topic, fut, True))
-        return await fut
+        await self._q.put((from_id, topic, fut, True, t0))
+        res = await fut
+        if t0 and self.cache is not None:  # see matches_for_fanout
+            self._rec_miss(time.perf_counter_ns() - t0, topic)
+        return res
 
     async def _collect(self):
         batch = [await self._q.get()]
@@ -207,11 +252,11 @@ class RoutingService:
         taken here — BEFORE the match runs — so a subscribe landing while
         the batch is in flight makes the entry born-stale, never wrong."""
         if self.cache is None:
-            return [(fid, topic) for fid, topic, _, _ in batch], None
+            return [(fid, topic) for fid, topic, _, _, _ in batch], None
         order: dict = {}
         items: list = []
         groups: list = []
-        for i, (_fid, topic, _fut, _raw) in enumerate(batch):
+        for i, (_fid, topic, _fut, _raw, _t) in enumerate(batch):
             j = order.get(topic)
             if j is None:
                 order[topic] = len(items)
@@ -223,7 +268,7 @@ class RoutingService:
 
     def _resolve(self, batch, results, groups=None) -> None:
         if groups is None:
-            for (_, _, fut, raw), res in zip(batch, results):
+            for (_, _, fut, raw, _t), res in zip(batch, results):
                 if fut.done():
                     continue
                 try:
@@ -243,7 +288,7 @@ class RoutingService:
             # only be consumed directly when no other waiter derives from it
             raw_free = entry.stored or len(idxs) == 1
             for i in idxs:
-                fid, _topic, fut, raw = batch[i]
+                fid, _topic, fut, raw, _t = batch[i]
                 if fut.done():
                     continue
                 try:
@@ -258,7 +303,7 @@ class RoutingService:
 
     @staticmethod
     def _reject(batch, exc) -> None:
-        for _, _, fut, _ in batch:
+        for _, _, fut, _, _ in batch:
             if not fut.done():
                 fut.set_exception(exc)
 
@@ -288,11 +333,23 @@ class RoutingService:
             len(items) if self.dispatches == 1
             else 0.9 * self.batch_size_ema + 0.1 * len(items)
         )
+        tele = self.tele
+        t_disp = 0
+        if tele.enabled:
+            t_disp = time.perf_counter_ns()
+            rec_qwait = self._rec_qwait
+            for it in batch:
+                if it[4]:
+                    rec_qwait(t_disp - it[4], it[1])
+            tele.record("routing.batch_size", len(items))
         if inline_ok(len(items)):
             try:
                 self._resolve(batch, self.router.matches_batch_raw(items), groups)
             except Exception as e:
                 self._reject(batch, e)
+            finally:
+                if t_disp:
+                    self._record_match(t_disp, len(items))
             return
         if pipelined:
             # in-flight bound: block BEFORE submitting so at most
@@ -319,8 +376,10 @@ class RoutingService:
                 self.inflight -= 1
                 self._pipe_sem.release()
                 self._resolve(batch, payload, groups)
+                if t_disp:
+                    self._record_match(t_disp, len(items))
                 return
-            await self._completion_q.put((batch, groups, payload))
+            await self._completion_q.put((batch, groups, payload, t_disp, len(items)))
             return
         self.inflight += 1
         try:
@@ -333,11 +392,20 @@ class RoutingService:
         finally:
             self.inflight -= 1
         self._resolve(batch, results, groups)
+        if t_disp:
+            self._record_match(t_disp, len(items))
+
+    def _record_match(self, t0: int, n: int) -> None:
+        """Per-dispatch backend match latency (submit → results expanded)."""
+        self.tele.record(
+            "routing.match", time.perf_counter_ns() - t0,
+            {"backend": type(self.router).__name__, "batch": n},
+        )
 
     async def _complete_loop(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            batch, groups, handle = await self._completion_q.get()
+            batch, groups, handle, t_disp, n = await self._completion_q.get()
             try:
                 results = await loop.run_in_executor(
                     None, self.router.complete_batch_raw, handle
@@ -350,6 +418,8 @@ class RoutingService:
                 self._reject(batch, e)
             else:
                 self._resolve(batch, results, groups)
+                if t_disp:
+                    self._record_match(t_disp, n)
             finally:
                 self.inflight -= 1
                 self._pipe_sem.release()

@@ -296,6 +296,9 @@ class MqttBroker:
         if entry is None:
             return
         ctx.handshake_rate.inc()
+        # connect-handshake latency stage: slot acquired → CONNACK decided
+        # (covers PROXY header, CONNECT read, auth hooks, takeover wait)
+        t0 = time.perf_counter_ns() if ctx.telemetry.enabled else 0
         try:
             if peer is _UNSET:
                 peer = writer.get_extra_info("peername")
@@ -316,6 +319,12 @@ class MqttBroker:
                 return
             connect, early = got
             state = await self._handshake(connect, reader, writer, codec, peer, early)
+            if t0:
+                ctx.telemetry.record(
+                    "connect.handshake", time.perf_counter_ns() - t0,
+                    {"client": connect.client_id,
+                     "ok": state is not None},
+                )
         finally:
             entry.release()
         if state is not None:

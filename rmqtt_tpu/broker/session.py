@@ -297,6 +297,9 @@ class SessionState:
         self._kicked = False
         self._closing = asyncio.Event()
         self._disconnect_reason: Optional[int] = None
+        # per-stage fast recorder (memoized in the registry; a no-op when
+        # telemetry is disabled — the t0 guard means it's never called)
+        self._rec_e2e = ctx.telemetry.recorder("publish.e2e")
         # packets a client pipelined behind CONNECT in the same TCP segment
         # (legal without waiting for CONNACK); replayed by _read_loop
         self.early_packets: list = []
@@ -564,6 +567,7 @@ class SessionState:
         elif isinstance(p, pk.Puback):
             e = s.out_inflight.ack(p.packet_id)
             if e is not None:
+                self._record_ack_rtt(e)
                 await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
         elif isinstance(p, pk.Pubrec):
             e = s.out_inflight.pubrec(p.packet_id)
@@ -574,6 +578,7 @@ class SessionState:
         elif isinstance(p, pk.Pubcomp):
             e = s.out_inflight.ack(p.packet_id)
             if e is not None:
+                self._record_ack_rtt(e)
                 await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
         elif isinstance(p, pk.Pubrel):
             s.in_qos2.remove(p.packet_id)
@@ -596,6 +601,19 @@ class SessionState:
         elif isinstance(p, pk.Connect):
             # second CONNECT is a protocol error (MQTT-3.1.0-2)
             self._closing.set()
+
+    def _record_ack_rtt(self, e: OutEntry) -> None:
+        """QoS1/2 ack round trip: last (re)delivery → PUBACK/PUBCOMP. Uses
+        the inflight entry's ``sent_at`` stamp, so a retried delivery
+        measures from its retransmission — the client-visible latency."""
+        tele = self.ctx.telemetry
+        if tele.enabled:
+            tele.record(
+                "deliver.ack_rtt",
+                int((time.monotonic() - e.sent_at) * 1e9),
+                {"topic": e.msg.topic, "qos": e.qos,
+                 "client": self.s.client_id},
+            )
 
     async def _on_auth(self, p: pk.Auth) -> None:
         """v5 re-authentication over the live connection (spec §4.12: client
@@ -672,7 +690,19 @@ class SessionState:
             await self.send(pk.Pubrec(p.packet_id, reason if self.codec.version == pk.V5 else 0))
 
     async def _publish(self, p: pk.Publish) -> Tuple[bool, int]:
-        """The ingress pipeline (session.rs _publish :966-1064)."""
+        """The ingress pipeline (session.rs _publish :966-1064).
+
+        Records the ``publish.e2e`` stage: PUBLISH decode handed to the
+        pipeline → the last local forward enqueued (cluster scatter
+        included for clustered registries) — the broker's dwell time, the
+        number every perf PR reports against."""
+        t0 = time.perf_counter_ns() if self.ctx.telemetry.enabled else 0
+        accepted, reason = await self._publish_inner(p)
+        if t0:
+            self._rec_e2e(time.perf_counter_ns() - t0, p.topic)
+        return accepted, reason
+
+    async def _publish_inner(self, p: pk.Publish) -> Tuple[bool, int]:
         s = self.s
         delay_secs = None
         topic = p.topic

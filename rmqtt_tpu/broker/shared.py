@@ -157,7 +157,12 @@ class SessionRegistry:
     # --------------------------------------------------------------- fanout
     async def forwards(self, msg: Message) -> int:
         """Route + deliver; returns the number of target subscribers
-        (shared.rs `forwards` :735-820 → `forwards_to` :876-963)."""
+        (shared.rs `forwards` :735-820 → `forwards_to` :876-963).
+
+        Latency note: the publish-e2e stage (`publish.e2e`) is recorded at
+        the MQTT ingress (`session.py _publish`) rather than here, so the
+        cluster registries — which override this method wholesale — share
+        the same instrumentation point."""
         # p2p short-circuit (shared.rs:743-769)
         if msg.target_clientid is not None:
             target = self._sessions.get(msg.target_clientid)

@@ -193,6 +193,10 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
         what = (body or {}).get("what")
         if what == "metrics":
             return {"metrics": ctx.metrics.to_json()}
+        if what == "latency":
+            # per-node latency histograms for /api/v1/latency/sum; buckets
+            # merge by addition on the requesting node
+            return {"latency": ctx.telemetry.snapshot()}
         if what == "offlines":
             from rmqtt_tpu.broker.http_api import client_info
 

@@ -160,6 +160,22 @@ class Settings:
     log: LogConfig = field(default_factory=LogConfig)
 
 
+def _apply_section(tree: Dict[str, Any], section: str,
+                   keys: Dict[str, Tuple[str, Any]],
+                   broker_kwargs: Dict[str, Any]) -> None:
+    """Map one flat TOML section onto BrokerConfig kwargs.
+
+    ``keys`` is ``toml_key → (field_name, converter)``; any key outside the
+    map raises, so typos fail at load instead of silently defaulting."""
+    body = tree.get(section, {})
+    unknown = set(body) - set(keys)
+    if unknown:
+        raise ValueError(f"unknown [{section}] keys: {sorted(unknown)}")
+    for key, (field_name, conv) in keys.items():
+        if key in body:
+            broker_kwargs[field_name] = conv(body[key])
+
+
 def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
          environ=None) -> Settings:
     """file (lowest) ← env ← cli (highest), like Settings::init + merge."""
@@ -243,30 +259,25 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         if "tpu_threshold" in retain:
             broker_kwargs["retain_tpu_threshold"] = int(retain["tpu_threshold"])
 
+    # flat-key config sections that map straight onto BrokerConfig fields:
+    # key → (field, converter); unknown keys in a section are an error
     # [routing] — batcher + match-result cache knobs (broker/routing.py,
-    # router/cache.py); flat names here map onto BrokerConfig fields
-    routing = tree.get("routing", {})
-    _ROUTING_KEYS = {
-        "cache": "route_cache",
-        "cache_capacity": "route_cache_capacity",
-        "cache_shared_bypass": "route_cache_shared_bypass",
-        "batch_max": "batch_max",
-        "linger_ms": "batch_linger_ms",
-        "pipeline_depth": "routing_pipeline_depth",
-    }
-    unknown_routing = set(routing) - set(_ROUTING_KEYS)
-    if unknown_routing:
-        raise ValueError(f"unknown [routing] keys: {sorted(unknown_routing)}")
-    for key, field_name in _ROUTING_KEYS.items():
-        if key in routing:
-            v = routing[key]
-            if key in ("cache", "cache_shared_bypass"):
-                v = bool(v)
-            elif key == "linger_ms":
-                v = float(v)
-            else:
-                v = int(v)
-            broker_kwargs[field_name] = v
+    # router/cache.py)
+    _apply_section(tree, "routing", {
+        "cache": ("route_cache", bool),
+        "cache_capacity": ("route_cache_capacity", int),
+        "cache_shared_bypass": ("route_cache_shared_bypass", bool),
+        "batch_max": ("batch_max", int),
+        "linger_ms": ("batch_linger_ms", float),
+        "pipeline_depth": ("routing_pipeline_depth", int),
+    }, broker_kwargs)
+    # [observability] — latency telemetry knobs (broker/telemetry.py):
+    # histograms + slow-op ring; enable=false makes every span a no-op
+    _apply_section(tree, "observability", {
+        "enable": ("telemetry_enable", bool),
+        "slow_ms": ("telemetry_slow_ms", float),
+        "slow_log_max": ("telemetry_slow_log_max", int),
+    }, broker_kwargs)
 
     cluster_listen = None
     raft_db = None

@@ -111,4 +111,29 @@ class SysTopicPlugin(Plugin):
             await self._publish(
                 f"{self._prefix}/metrics", json.dumps(self.ctx.metrics.to_json()).encode()
             )
+            await self._publish_latency()
             await asyncio.sleep(self.interval)
+
+    async def _publish_latency(self) -> None:
+        """$SYS/brokers/<node>/latency/<stage path>: one compact row per
+        telemetry stage (dots become topic levels, so ``latency/#``
+        subscribes to all of them and ``latency/publish/#`` to the publish
+        stages) plus the slow-op ring under ``latency/slow_ops``."""
+        tele = getattr(self.ctx, "telemetry", None)
+        if tele is None or not tele.enabled:
+            return
+        snap = tele.snapshot()
+        for stage, row in snap["histograms"].items():
+            if not row["count"]:
+                continue  # quiet stages publish nothing, not zeros
+            await self._publish(
+                f"{self._prefix}/latency/{stage.replace('.', '/')}",
+                json.dumps({k: row[k] for k in
+                            ("count", "sum", "unit", "mean",
+                             "p50", "p90", "p99", "p999")}).encode(),
+            )
+        if snap["slow_ops"]:
+            await self._publish(
+                f"{self._prefix}/latency/slow_ops",
+                json.dumps(snap["slow_ops"]).encode(),
+            )

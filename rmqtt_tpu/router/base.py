@@ -90,6 +90,11 @@ class Router(abc.ABC):
     # device-backed routers leave this False (their kernels block)
     prefer_inline: bool = False
 
+    # latency telemetry registry (broker/telemetry.py), injected by
+    # ServerContext at broker startup; None for standalone routers. The
+    # native/xla routers record their ``kernel.dispatch`` stage through it.
+    telemetry = None
+
     # True ONLY for routers whose add()/remove() bump ``epochs`` on every
     # mutation — the bundled trie/native/xla routers do. RoutingService
     # keys its match cache on THIS flag, not on ``epochs`` existing (the

@@ -9,6 +9,7 @@ flatter the TPU numbers).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from rmqtt_tpu.router.base import (
@@ -68,7 +69,17 @@ class NativeRouter(Router):
         return expand_matches_raw(matched, self._relations, from_id, self._is_online)
 
     def matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
+        tele = self.telemetry
+        t0 = time.perf_counter_ns() if tele is not None and tele.enabled else 0
         rows = self._trie.match_batch([topic for _, topic in items])
+        if t0:
+            # recorder, not record(): this can run on an executor thread
+            # concurrently with loop-side records — the recorder's append
+            # + locked fold keeps totals exact across threads (memoized,
+            # so the lookup is one dict hit per batch)
+            tele.recorder("kernel.dispatch")(
+                time.perf_counter_ns() - t0,
+                {"backend": "native", "batch": len(items)})
         out = []
         for (from_id, _topic), vids in zip(items, rows):
             matched = [self._filter_by_vid[v] for v in vids.tolist()]

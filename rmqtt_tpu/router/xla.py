@@ -12,6 +12,7 @@ SURVEY.md §7 "hard parts".
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from rmqtt_tpu.ops.encode import FilterTable
@@ -191,7 +192,17 @@ class XlaRouter(Router):
 
     def matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
         topics = [topic for _, topic in items]
-        return self._expand(items, self._hybrid.match(topics))
+        tele = self.telemetry
+        t0 = time.perf_counter_ns() if tele is not None and tele.enabled else 0
+        rows = self._hybrid.match(topics)
+        if t0:
+            # recorder, not record(): executor threads record this stage
+            # concurrently with the loop — append + locked fold keeps
+            # totals exact (see telemetry.recorder)
+            tele.recorder("kernel.dispatch")(
+                time.perf_counter_ns() - t0,
+                {"backend": "xla", "batch": len(items)})
+        return self._expand(items, rows)
 
     def _expand(self, items, fid_rows):
         out = []
@@ -212,14 +223,29 @@ class XlaRouter(Router):
     def submit_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
         items = list(items)
         topics = [topic for _, topic in items]
+        tele = self.telemetry
+        t0 = time.perf_counter_ns() if tele is not None and tele.enabled else 0
         h = self._hybrid.match_submit(topics)
         if h[0] == "sync":
-            return True, self._expand(items, h[1])
-        return False, (items, h)
+            out = True, self._expand(items, h[1])
+            if t0:
+                tele.recorder("kernel.dispatch")(
+                    time.perf_counter_ns() - t0,
+                    {"backend": "xla-sync", "batch": len(items)})
+            return out
+        # async device dispatch: the kernel stage closes at complete time
+        return False, (items, h, t0)
 
     def complete_batch_raw(self, handle):
-        items, h = handle
-        return self._expand(items, self._hybrid.match_complete(h))
+        items, h, t0 = handle
+        rows = self._hybrid.match_complete(h)
+        if t0:
+            tele = self.telemetry
+            if tele is not None:
+                tele.recorder("kernel.dispatch")(
+                    time.perf_counter_ns() - t0,
+                    {"backend": "xla", "batch": len(items)})
+        return self._expand(items, rows)
 
     def is_match(self, topic: str) -> bool:
         if self._side is not None:

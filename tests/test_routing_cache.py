@@ -337,7 +337,7 @@ def test_routing_service_batch_dedup_and_raw_waiters():
         # so they arrive as one batch
         futs = [asyncio.get_running_loop().create_future() for _ in range(8)]
         for i, fut in enumerate(futs):
-            await svc._q.put((None, "hot/t", fut, i % 2 == 1))
+            await svc._q.put((None, "hot/t", fut, i % 2 == 1, 0))
         svc.start()
         try:
             results = await asyncio.gather(*futs)

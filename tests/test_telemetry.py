@@ -1,0 +1,302 @@
+"""Latency telemetry tests (broker/telemetry.py + the admin surfaces).
+
+Three tiers:
+- Histogram properties against an exact sorted oracle (quantiles bracket
+  within one log2 bucket; bucket-merge == combined-sample histogram).
+- Exposition-format scrape: every `/metrics/prometheus` line must parse
+  against the text-format grammar, counters must end in ``_total``.
+- End-to-end: a live broker with a 0 ms slow threshold records queue-wait /
+  match / e2e spans with sane orderings; disabled mode stays shape-stable
+  and records NOTHING.
+"""
+
+import asyncio
+import json
+import random
+import re
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.http_api import HttpApi
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.broker.telemetry import (
+    NBUCKETS,
+    STAGES,
+    Histogram,
+    Telemetry,
+)
+
+from tests.mqtt_client import TestClient
+from tests.test_http_plugins import http_get
+
+QS = (0.5, 0.9, 0.99, 0.999)
+
+
+def _oracle(samples, q):
+    s = sorted(samples)
+    rank = max(1, min(len(s), int(q * len(s) + 0.999999)))
+    return s[rank - 1]
+
+
+# ------------------------------------------------------------ histogram unit
+
+
+def test_histogram_quantiles_bracket_sorted_oracle():
+    """Property: for random duration sets across magnitudes, the estimate is
+    the exclusive upper bound of the bucket holding the exact order
+    statistic — i.e. exact-to-one-bucket-boundary."""
+    rng = random.Random(7)
+    for trial in range(20):
+        n = rng.randint(1, 4000)
+        # span ns → minutes; mix magnitudes within one set
+        samples = [int(10 ** rng.uniform(0, 11.5)) for _ in range(n)]
+        h = Histogram()
+        for v in samples:
+            h.record(v)
+        assert h.count == n and h.sum == sum(samples)
+        for q in QS:
+            est = h.quantile(q)
+            exact = _oracle(samples, q)
+            assert exact < est, (trial, q, exact, est)
+            # same bucket: est is that bucket's (exclusive) upper bound
+            assert Histogram.bucket_index(exact) == Histogram.bucket_index(
+                int(est) - 1
+            ), (trial, q, exact, est)
+
+
+def test_histogram_merge_equals_combined_samples():
+    rng = random.Random(11)
+    for _ in range(10):
+        a = [int(10 ** rng.uniform(0, 10)) for _ in range(rng.randint(0, 500))]
+        b = [int(10 ** rng.uniform(0, 10)) for _ in range(rng.randint(0, 500))]
+        ha, hb, hab = Histogram(), Histogram(), Histogram()
+        for v in a:
+            ha.record(v)
+        for v in b:
+            hb.record(v)
+        for v in a + b:
+            hab.record(v)
+        ha.merge(hb)
+        assert ha.counts == hab.counts
+        assert ha.count == hab.count and ha.sum == hab.sum
+        for q in QS:
+            assert ha.quantile(q) == hab.quantile(q)
+
+
+def test_histogram_edges_zero_and_overflow():
+    h = Histogram()
+    h.record(0)
+    h.record(1)
+    assert h.counts[0] == 2
+    h.record(1 << 50)  # way past the top bucket: absorbed, not lost
+    assert h.counts[NBUCKETS - 1] == 1
+    assert h.count == 3
+    assert h.quantile(0.999) == float(1 << NBUCKETS)
+    # round-trip through the wire shape
+    assert Histogram.from_json(h.to_json()).counts == h.counts
+
+
+def test_telemetry_span_slow_log_and_disabled_noop():
+    tele = Telemetry(enabled=True, slow_ms=0.0, slow_log_max=4)
+    with tele.span("connect.handshake", {"client": "c1"}):
+        pass
+    assert tele.hist("connect.handshake").count == 1
+    assert tele.slow_ops and tele.slow_ops[-1]["op"] == "connect.handshake"
+    assert tele.slow_ops[-1]["detail"] == {"client": "c1"}
+    # count-unit stages never reach the slow log even at threshold 0
+    tele.record("routing.batch_size", 64)
+    assert all(op["op"] != "routing.batch_size" for op in tele.slow_ops)
+    # ring is bounded
+    for i in range(10):
+        tele.record("publish.e2e", 1000, i)
+    assert len(tele.slow_ops) == 4
+
+    off = Telemetry(enabled=False, slow_ms=0.0)
+    with off.span("publish.e2e"):
+        pass
+    off.record("publish.e2e", 123)
+    assert off.hist("publish.e2e").count == 0
+    assert not off.slow_ops
+    snap = off.snapshot()
+    assert snap["enabled"] is False
+    assert set(snap["histograms"]) == set(STAGES)  # shape-stable when off
+
+
+def test_merge_snapshots_cluster_sum():
+    a, b = Telemetry(), Telemetry()
+    for v in (1_000, 2_000_000):
+        a.record("publish.e2e", v)
+    b.record("publish.e2e", 3_000_000_000)
+    merged = Telemetry.merge_snapshots(a.snapshot(), [b.snapshot()])
+    assert merged["nodes"] == 2
+    row = merged["histograms"]["publish.e2e"]
+    assert row["count"] == 3 and row["sum"] == 3_002_001_000
+
+
+# ------------------------------------------------------- live-broker fixtures
+
+
+def broker_test(**cfg):
+    """Like test_http_plugins.api_test but with BrokerConfig overrides."""
+
+    def deco(fn):
+        def wrapper():
+            async def run():
+                b = MqttBroker(ServerContext(BrokerConfig(port=0, **cfg)))
+                api = HttpApi(b.ctx, port=0)
+                await b.start()
+                await api.start()
+                try:
+                    await asyncio.wait_for(fn(b, api), timeout=30.0)
+                finally:
+                    await api.stop()
+                    await b.stop()
+
+            asyncio.run(run())
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return deco
+
+
+_EXPOSITION_COMMENT = re.compile(
+    r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (gauge|counter|histogram)|HELP .*)$"
+)
+_EXPOSITION_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*\})?'
+    r" [-+]?([0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[0-9]*\.[0-9]+([eE][+-]?[0-9]+)?)$"
+)
+
+
+async def _traffic(broker):
+    """A little of everything: connect, subscribe, QoS1 publishes."""
+    sub = await TestClient.connect(broker.port, "tele-sub", version=pk.V5)
+    await sub.subscribe("t/#", qos=1)
+    publ = await TestClient.connect(broker.port, "tele-pub", version=pk.V5)
+    for i in range(6):
+        await publ.publish(f"t/{i}", b"x", qos=1)  # waits for PUBACK
+    # let the subscriber's deliveries (and their acks) land
+    for _ in range(6):
+        await sub.recv()
+    await asyncio.sleep(0.05)
+    return sub, publ
+
+
+@broker_test(telemetry_slow_ms=0.0)
+async def test_prometheus_scrape_grammar(broker, api):
+    await _traffic(broker)
+    status, body = await http_get(api.bound_port, "/metrics/prometheus")
+    assert status == 200
+    lines = body.decode().strip().split("\n")
+    assert lines, "empty exposition"
+    for line in lines:
+        if line.startswith("#"):
+            assert _EXPOSITION_COMMENT.match(line), f"bad comment line: {line!r}"
+        else:
+            assert _EXPOSITION_SAMPLE.match(line), f"bad sample line: {line!r}"
+    # counters (ctx.metrics) carry the conventional _total suffix — and the
+    # TYPE the exposition declares for them is counter, not gauge
+    counter_names = {
+        m.group(1)
+        for m in (re.match(r"^# TYPE (\S+) counter$", l) for l in lines)
+        if m
+    }
+    assert counter_names, "no counter families exported"
+    assert all(n.endswith("_total") for n in counter_names), counter_names
+    for k in broker.ctx.metrics.to_json():
+        safe = re.sub(r"[^a-zA-Z0-9_]", "_", k)
+        assert f"# TYPE rmqtt_{safe}_total counter" in lines
+    # latency histograms export the full _bucket/_sum/_count family
+    text = "\n".join(lines)
+    assert "# TYPE rmqtt_latency_publish_e2e_seconds histogram" in text
+    assert 'rmqtt_latency_publish_e2e_seconds_bucket{node="1",le="+Inf"}' in text
+    assert "rmqtt_latency_publish_e2e_seconds_count" in text
+    # name sanitization: dotted counter keys never leak a '.'
+    for line in lines:
+        assert "." not in line.split("{")[0].split(" ")[-1].replace("# TYPE ", ""), line
+
+
+@broker_test(telemetry_slow_ms=0.0)
+async def test_latency_endpoint_end_to_end(broker, api):
+    await _traffic(broker)
+    status, body = await http_get(api.bound_port, "/api/v1/latency")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["enabled"] is True and snap["node"] == 1
+    hs = snap["histograms"]
+    assert set(hs) >= set(STAGES)
+    # six distinct-topic QoS1 publishes, all cache misses → all stages hot
+    assert hs["publish.e2e"]["count"] >= 6
+    assert hs["routing.queue_wait"]["count"] >= 6
+    assert hs["publish.cache_miss"]["count"] >= 6
+    assert hs["routing.match"]["count"] >= 1
+    assert hs["routing.batch_size"]["count"] >= 1
+    assert hs["connect.handshake"]["count"] >= 2
+    assert hs["deliver.ack_rtt"]["count"] >= 1
+    # sane ordering: every publish's queue wait is contained in its e2e, and
+    # sums/counts are EXACT (only quantiles are bucket-estimates) — compare
+    # means, which inherit the per-publish ordering
+    qw, e2e = hs["routing.queue_wait"], hs["publish.e2e"]
+    assert qw["sum"] / qw["count"] <= e2e["sum"] / e2e["count"]
+    assert 0 < e2e["p50"] <= e2e["p99"] <= e2e["p999"]
+    # slow threshold is 0 ms in this fixture: the ring saw every op
+    ops = {op["op"] for op in snap["slow_ops"]}
+    assert {"publish.e2e", "routing.queue_wait", "routing.match"} <= ops
+    # single-node cluster merge: same totals, nodes == 1
+    status, body = await http_get(api.bound_port, "/api/v1/latency/sum")
+    merged = json.loads(body)
+    assert merged["nodes"] == 1
+    assert merged["histograms"]["publish.e2e"]["count"] == e2e["count"]
+    # percentile gauges ride the stats surface too
+    status, body = await http_get(api.bound_port, "/api/v1/stats")
+    stats = json.loads(body)[0]["stats"]
+    assert stats["publish_e2e_p99_ms"] > 0
+    assert stats["routing_queue_wait_p99_ms"] > 0
+
+
+@broker_test(telemetry_enable=False, telemetry_slow_ms=0.0)
+async def test_latency_disabled_shape_stable(broker, api):
+    await _traffic(broker)
+    # hot paths recorded NOTHING: no histogram touches, no slow-log appends
+    tele = broker.ctx.telemetry
+    assert all(h.count == 0 for h in tele._h.values())
+    assert not tele.slow_ops
+    status, body = await http_get(api.bound_port, "/api/v1/latency")
+    snap = json.loads(body)
+    assert snap["enabled"] is False
+    assert set(snap["histograms"]) == set(STAGES)  # same shape as enabled
+    assert all(h["count"] == 0 for h in snap["histograms"].values())
+    assert snap["slow_ops"] == []
+    status, body = await http_get(api.bound_port, "/api/v1/latency/sum")
+    assert json.loads(body)["nodes"] == 1
+    # stats percentile gauges exist and read zero
+    status, body = await http_get(api.bound_port, "/api/v1/stats")
+    stats = json.loads(body)[0]["stats"]
+    assert stats["publish_e2e_p99_ms"] == 0
+    assert stats["routing_match_p50_ms"] == 0
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_conf_observability_section(tmp_path):
+    from rmqtt_tpu import conf
+
+    p = tmp_path / "obs.toml"
+    p.write_text(
+        "[observability]\nenable = false\nslow_ms = 5.5\nslow_log_max = 32\n"
+    )
+    s = conf.load(str(p))
+    assert s.broker.telemetry_enable is False
+    assert s.broker.telemetry_slow_ms == 5.5
+    assert s.broker.telemetry_slow_log_max == 32
+    bad = tmp_path / "bad.toml"
+    bad.write_text("[observability]\nnope = 1\n")
+    try:
+        conf.load(str(bad))
+    except ValueError as e:
+        assert "observability" in str(e)
+    else:
+        raise AssertionError("unknown [observability] key must raise")
