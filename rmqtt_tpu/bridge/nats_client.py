@@ -58,6 +58,9 @@ class NatsClient:
         self.reconnect_min = reconnect_min
         self.reconnect_max = reconnect_max
         self.connected = asyncio.Event()
+        # server advertises header support (HPUB/HMSG) in its INFO line;
+        # publishes with headers fall back to plain PUB when unsupported
+        self._hdr_support = False
         self._writer: Optional[asyncio.StreamWriter] = None
         self._subs: Dict[int, Tuple[str, Optional[str]]] = {}  # sid → (subject, queue)
         self._sid = itertools.count(1)
@@ -103,8 +106,14 @@ class NatsClient:
             info = await asyncio.wait_for(reader.readline(), 10.0)
             if not info.startswith(b"INFO"):
                 raise ValueError(f"unexpected NATS greeting: {info[:40]!r}")
+            try:
+                self._hdr_support = bool(json.loads(info[4:]).get("headers"))
+            except (ValueError, AttributeError):
+                self._hdr_support = False
             opts = {"verbose": False, "pedantic": False, "name": self.name,
                     "lang": "python", "version": "0.1", "protocol": 0}
+            if self._hdr_support:
+                opts["headers"] = True  # opt in so the server accepts HPUB
             writer.write(b"CONNECT " + json.dumps(opts).encode() + b"\r\n")
             await writer.drain()
             self.connected.set()
@@ -148,9 +157,21 @@ class NatsClient:
             await self._writer.drain()
         return sid
 
-    async def publish(self, subject: str, payload: bytes) -> bool:
+    async def publish(self, subject: str, payload: bytes,
+                      headers: Optional[list] = None) -> bool:
+        """``headers`` is ``[(key, value), ...]``; sent as an HPUB header
+        block when the server supports headers, silently dropped (plain
+        PUB) when it doesn't — delivery beats metadata."""
         if not self.connected.is_set() or self._writer is None:
             return False
-        self._writer.write(f"PUB {subject} {len(payload)}\r\n".encode() + payload + b"\r\n")
+        if headers and self._hdr_support:
+            hdr = b"NATS/1.0\r\n" + b"".join(
+                f"{k}: {v}\r\n".encode() for k, v in headers) + b"\r\n"
+            self._writer.write(
+                f"HPUB {subject} {len(hdr)} {len(hdr) + len(payload)}\r\n".encode()
+                + hdr + payload + b"\r\n")
+        else:
+            self._writer.write(
+                f"PUB {subject} {len(payload)}\r\n".encode() + payload + b"\r\n")
         await self._writer.drain()
         return True

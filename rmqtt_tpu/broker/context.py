@@ -101,6 +101,14 @@ class BrokerConfig:
     telemetry_enable: bool = True
     telemetry_slow_ms: float = 100.0  # ring-log threshold per op
     telemetry_slow_log_max: int = 256  # bounded slow-op ring size
+    # distributed per-publish tracing (broker/tracing.py, same
+    # [observability] section): head-sampling probability plus
+    # always-record-on-slow (shares telemetry_slow_ms); bounded in-memory
+    # span store. Tracing follows telemetry_enable — disabled means no
+    # trace ids, no span allocations, no timestamps.
+    trace_sample: float = 0.01  # probability a publish is head-sampled
+    trace_max_traces: int = 512  # committed traces kept (FIFO eviction)
+    trace_max_spans: int = 64  # spans kept per trace
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -124,6 +132,19 @@ class ServerContext:
             enabled=self.cfg.telemetry_enable,
             slow_ms=self.cfg.telemetry_slow_ms,
             slow_log_max=self.cfg.telemetry_slow_log_max,
+        )
+        # per-publish trace registry (broker/tracing.py): shares the
+        # telemetry enable/slow knobs so "slow" means the same thing in
+        # the ring log, the histograms and the span store
+        from rmqtt_tpu.broker.tracing import Tracer
+
+        self.tracer = Tracer(
+            enabled=self.cfg.telemetry_enable,
+            sample=self.cfg.trace_sample,
+            max_traces=self.cfg.trace_max_traces,
+            max_spans=self.cfg.trace_max_spans,
+            slow_ms=self.cfg.telemetry_slow_ms,
+            node_id=self.cfg.node_id,
         )
         # v5 enhanced-auth seam (broker/auth.py); None = AUTH methods refused
         self.enhanced_auth = None

@@ -24,8 +24,6 @@ from rmqtt_tpu.router.base import Id
 
 log = logging.getLogger("rmqtt_tpu.http")
 
-_STARTED_AT = time.time()
-
 
 def sysinfo() -> dict:
     """Host load/memory figures (node.rs sysinfo surface)."""
@@ -143,12 +141,20 @@ class HttpApi:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
+        # uptime base: MONOTONIC, re-anchored at server start — wall clock
+        # (time.time) is NTP-step sensitive and a module-import stamp
+        # predates the server; both /brokers and /nodes read this
+        self._started_mono = time.monotonic()
 
     @property
     def bound_port(self) -> int:
         return self._server.sockets[0].getsockname()[1]
 
+    def _uptime(self) -> float:
+        return round(time.monotonic() - self._started_mono, 1)
+
     async def start(self) -> None:
+        self._started_mono = time.monotonic()
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         log.info("http api on %s:%s", self.host, self.bound_port)
 
@@ -228,6 +234,8 @@ class HttpApi:
                 "/api/v1/stats", "/api/v1/stats/sum",
                 "/api/v1/metrics", "/api/v1/metrics/sum",
                 "/api/v1/latency", "/api/v1/latency/sum",
+                "/api/v1/traces", "/api/v1/traces/slow",
+                "/api/v1/traces/{trace_id}",
                 "/api/v1/plugins", "/api/v1/plugins/{plugin}",
                 "/api/v1/mqtt/publish", "/api/v1/mqtt/subscribe",
                 "/api/v1/mqtt/unsubscribe", "/metrics/prometheus",
@@ -393,6 +401,30 @@ class HttpApi:
             # stage histograms + slow-op ring (broker/telemetry.py);
             # shape-stable with telemetry disabled (zero-count stages)
             return 200, {"node": ctx.node_id, **ctx.telemetry.snapshot()}, J
+        if path == "/api/v1/traces/slow":
+            # slow traces cluster-wide (broker/tracing.py): per-node
+            # summaries merged + deduped by trace id
+            return 200, await self._trace_listing(q, slow=True), J
+        if path.startswith("/api/v1/traces/"):
+            # one trace, STITCHED cluster-wide: this node's spans plus every
+            # peer's (what=traces DATA query) merged on the shared timeline
+            # — retrievable from any node that can reach the others
+            from rmqtt_tpu.broker.tracing import Tracer
+
+            tid = path[len("/api/v1/traces/"):]
+            parts = []
+            local = ctx.tracer.get(tid)
+            if local is not None:
+                parts.append(local)
+            parts += await _cluster_merge(
+                ctx, M.DATA, {"what": "traces", "id": tid},
+                lambda r: [r["trace"]] if r.get("trace") else [],
+            )
+            if not parts:
+                return 404, {"error": "no such trace"}, J
+            return 200, Tracer.merge_traces(parts), J
+        if path == "/api/v1/traces":
+            return 200, await self._trace_listing(q, slow=False), J
         if path.startswith("/api/v1/plugins/"):
             # single-plugin control (api.rs plugins/{plugin}[/load|/unload|
             # /config/reload])
@@ -466,11 +498,29 @@ class HttpApi:
         return 404, {"error": "no such endpoint"}, J
 
     # --------------------------------------------------------------- bodies
+    async def _trace_listing(self, q, slow: bool) -> dict:
+        """Shared body of /api/v1/traces[/slow]: local summaries + every
+        peer's (what=traces DATA query), deduped by trace id so a trace
+        whose spans live on several nodes lists once."""
+        from rmqtt_tpu.broker.tracing import Tracer
+
+        ctx = self.ctx
+        limit = int(q.get("_limit", ["50"])[0])
+        rows = (ctx.tracer.slow_traces(limit) if slow
+                else ctx.tracer.recent(limit))
+        body = {"what": "traces", "limit": limit}
+        if slow:
+            body["slow"] = True
+        rows += await _cluster_merge(
+            ctx, M.DATA, body, lambda r: r.get("traces", []))
+        return {"node": ctx.node_id, **ctx.tracer.snapshot(),
+                "traces": Tracer.dedup_summaries(rows)[:limit]}
+
     def _broker_info(self) -> dict:
         return {
             "node_id": self.ctx.node_id,
             "version": __version__,
-            "uptime": round(time.time() - _STARTED_AT, 1),
+            "uptime": self._uptime(),
             "sysdescr": "rmqtt_tpu broker",
             "datetime": time.strftime("%Y-%m-%d %H:%M:%S"),
         }
@@ -484,16 +534,28 @@ class HttpApi:
             "subscriptions": stats.subscriptions,
             "retaineds": stats.retaineds,
             "version": __version__,
-            "uptime": round(time.time() - _STARTED_AT, 1),
+            "uptime": self._uptime(),
             **sysinfo(),
         }
 
     def _prometheus(self) -> str:
+        import sys
+
         from rmqtt_tpu.broker.telemetry import prom_sanitize as sanitize
 
         stats = self.ctx.stats().to_json()
         lines = []
         labels = f'node="{self.ctx.node_id}"'
+        # process-level gauges: uptime (monotonic base) + a build/version
+        # info gauge (the conventional constant-1 "info" metric, so
+        # dashboards can join on version/python labels)
+        lines.append("# TYPE rmqtt_uptime_seconds gauge")
+        lines.append(f"rmqtt_uptime_seconds{{{labels}}} {self._uptime()}")
+        pyver = "%d.%d.%d" % sys.version_info[:3]
+        lines.append("# TYPE rmqtt_build_info gauge")
+        lines.append(
+            f'rmqtt_build_info{{{labels},version="{__version__}",'
+            f'python="{pyver}"}} 1')
         for k, v in stats.items():
             name = "rmqtt_" + sanitize(k)
             lines.append(f"# TYPE {name} gauge")
@@ -506,6 +568,8 @@ class HttpApi:
             lines.append(f"{name}{{{labels}}} {v}")
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
+        # tracing counters + span-store gauge (broker/tracing.py)
+        lines.extend(self.ctx.tracer.prometheus_lines(labels))
         return "\n".join(lines) + "\n"
 
 

@@ -39,6 +39,10 @@ class OutEntry:
     # it (retain-as-published flag, v5 content/correlation/sub-id props)
     retain: bool = False
     wire_props: dict = field(default_factory=dict)
+    # trace of the publish this delivery belongs to (broker/tracing.py):
+    # the PUBACK/PUBCOMP arrives in the read loop, a different task from
+    # the fan-out, so the context must travel with the inflight entry
+    trace: object = None
 
 
 class OutInflight:

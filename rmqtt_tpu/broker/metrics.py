@@ -9,7 +9,7 @@ reports its own batch counters.
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, Union
 
 
 class Metrics:
@@ -73,5 +73,12 @@ class Stats:
         self.publish_e2e_p50_ms = 0.0
         self.publish_e2e_p99_ms = 0.0
 
-    def to_json(self) -> Dict[str, int]:
-        return dict(vars(self))
+    def to_json(self) -> Dict[str, Union[int, float]]:
+        """Gauge dict for the admin surfaces. Most gauges are ints; the
+        ``*_ms``/``*_ema`` keys are floats — rounded to 3 decimals HERE so
+        every consumer (/stats, /stats/sum inputs, $SYS, dashboards) sees
+        the same shape regardless of which path filled the gauge."""
+        return {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in vars(self).items()
+        }

@@ -33,6 +33,7 @@ import time
 from typing import List, Optional, Tuple
 
 from rmqtt_tpu.broker.telemetry import NULL_TELEMETRY, Telemetry
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.router.base import Id, Router, SubRelationsMap
 from rmqtt_tpu.router.cache import MatchCache
 
@@ -182,39 +183,56 @@ class RoutingService:
         under flood). The hit path preserves that cooperative yield with an
         explicit sleep(0), still far cheaper than the queue round trip."""
         t0 = time.perf_counter_ns() if self.tele.enabled else 0
+        # the active trace rides the queue item so the batcher task can
+        # stamp queue-wait/match spans onto it (broker/tracing.py); spans
+        # reuse t0 and the dispatch timestamps — no extra clock reads
+        trace = CURRENT_TRACE.get() if t0 else None
         entry = self._cache_lookup(topic)
         if entry is not None:
             await asyncio.sleep(0)
             out = self.router.collapse(self.cache.derive(entry, from_id))
             if t0:
-                self._rec_hit(time.perf_counter_ns() - t0, topic)
+                dur = time.perf_counter_ns() - t0
+                self._rec_hit(dur, topic, trace)
+                if trace is not None:
+                    trace.add("publish.cache_hit", t0, dur, topic)
             return out, True
         fut = asyncio.get_running_loop().create_future()
         # t0 doubles as the enqueue timestamp for the queue-wait histogram
-        await self._q.put((from_id, topic, fut, False, t0))
+        await self._q.put((from_id, topic, fut, False, t0, trace))
         res = await fut
         # only meaningful with the cache on: a cache-off broker recording
         # every publish as a "miss" would read as a malfunctioning cache
         # (same rule as the hit/miss counters in shared.forwards)
         if t0 and self.cache is not None:
-            self._rec_miss(time.perf_counter_ns() - t0, topic)
+            dur = time.perf_counter_ns() - t0
+            self._rec_miss(dur, topic, trace)
+            if trace is not None:
+                trace.add("publish.cache_miss", t0, dur, topic)
         return res, False
 
     async def matches_raw(self, from_id: Optional[Id], topic: str):
         """Un-collapsed variant for cluster-global shared-group choice."""
         t0 = time.perf_counter_ns() if self.tele.enabled else 0
+        trace = CURRENT_TRACE.get() if t0 else None
         entry = self._cache_lookup(topic)
         if entry is not None:
             await asyncio.sleep(0)  # keep the cooperative yield (see above)
             out = self.cache.derive(entry, from_id)
             if t0:
-                self._rec_hit(time.perf_counter_ns() - t0, topic)
+                dur = time.perf_counter_ns() - t0
+                self._rec_hit(dur, topic, trace)
+                if trace is not None:
+                    trace.add("publish.cache_hit", t0, dur, topic)
             return out
         fut = asyncio.get_running_loop().create_future()
-        await self._q.put((from_id, topic, fut, True, t0))
+        await self._q.put((from_id, topic, fut, True, t0, trace))
         res = await fut
         if t0 and self.cache is not None:  # see matches_for_fanout
-            self._rec_miss(time.perf_counter_ns() - t0, topic)
+            dur = time.perf_counter_ns() - t0
+            self._rec_miss(dur, topic, trace)
+            if trace is not None:
+                trace.add("publish.cache_miss", t0, dur, topic)
         return res
 
     async def _collect(self):
@@ -252,11 +270,11 @@ class RoutingService:
         taken here — BEFORE the match runs — so a subscribe landing while
         the batch is in flight makes the entry born-stale, never wrong."""
         if self.cache is None:
-            return [(fid, topic) for fid, topic, _, _, _ in batch], None
+            return [(fid, topic) for fid, topic, *_ in batch], None
         order: dict = {}
         items: list = []
         groups: list = []
-        for i, (_fid, topic, _fut, _raw, _t) in enumerate(batch):
+        for i, (_fid, topic, _fut, _raw, _t, _tr) in enumerate(batch):
             j = order.get(topic)
             if j is None:
                 order[topic] = len(items)
@@ -268,7 +286,7 @@ class RoutingService:
 
     def _resolve(self, batch, results, groups=None) -> None:
         if groups is None:
-            for (_, _, fut, raw, _t), res in zip(batch, results):
+            for (_, _, fut, raw, _t, _tr), res in zip(batch, results):
                 if fut.done():
                     continue
                 try:
@@ -288,7 +306,7 @@ class RoutingService:
             # only be consumed directly when no other waiter derives from it
             raw_free = entry.stored or len(idxs) == 1
             for i in idxs:
-                fid, _topic, fut, raw, _t = batch[i]
+                fid, _topic, fut, raw, _t, _tr = batch[i]
                 if fut.done():
                     continue
                 try:
@@ -303,7 +321,8 @@ class RoutingService:
 
     @staticmethod
     def _reject(batch, exc) -> None:
-        for _, _, fut, _, _ in batch:
+        for it in batch:
+            fut = it[2]
             if not fut.done():
                 fut.set_exception(exc)
 
@@ -340,7 +359,11 @@ class RoutingService:
             rec_qwait = self._rec_qwait
             for it in batch:
                 if it[4]:
-                    rec_qwait(t_disp - it[4], it[1])
+                    wait = t_disp - it[4]
+                    tr = it[5]
+                    rec_qwait(wait, it[1], tr)
+                    if tr is not None:  # same t0/t_disp reads as the stage
+                        tr.add("routing.queue_wait", it[4], wait, it[1])
             tele.record("routing.batch_size", len(items))
         if inline_ok(len(items)):
             try:
@@ -349,7 +372,7 @@ class RoutingService:
                 self._reject(batch, e)
             finally:
                 if t_disp:
-                    self._record_match(t_disp, len(items))
+                    self._record_match(t_disp, len(items), batch)
             return
         if pipelined:
             # in-flight bound: block BEFORE submitting so at most
@@ -377,7 +400,7 @@ class RoutingService:
                 self._pipe_sem.release()
                 self._resolve(batch, payload, groups)
                 if t_disp:
-                    self._record_match(t_disp, len(items))
+                    self._record_match(t_disp, len(items), batch)
                 return
             await self._completion_q.put((batch, groups, payload, t_disp, len(items)))
             return
@@ -393,14 +416,26 @@ class RoutingService:
             self.inflight -= 1
         self._resolve(batch, results, groups)
         if t_disp:
-            self._record_match(t_disp, len(items))
+            self._record_match(t_disp, len(items), batch)
 
-    def _record_match(self, t0: int, n: int) -> None:
-        """Per-dispatch backend match latency (submit → results expanded)."""
-        self.tele.record(
-            "routing.match", time.perf_counter_ns() - t0,
-            {"backend": type(self.router).__name__, "batch": n},
-        )
+    def _record_match(self, t0: int, n: int, batch=None) -> None:
+        """Per-dispatch backend match latency (submit → results expanded).
+        The same timestamp pair also stamps a ``routing.match`` span onto
+        every traced item of the batch — the per-publish view of the
+        kernel dispatch (backend name = native/xla/trie in the detail).
+        A slow dispatch's ring entry carries the batch's first trace id
+        (the batcher task has no trace contextvar of its own)."""
+        dur = time.perf_counter_ns() - t0
+        detail = {"backend": type(self.router).__name__, "batch": n}
+        first_trace = None
+        if batch is not None:
+            for it in batch:
+                tr = it[5]
+                if tr is not None:
+                    if first_trace is None:
+                        first_trace = tr
+                    tr.add("routing.match", t0, dur, detail)
+        self.tele.record("routing.match", dur, detail, first_trace)
 
     async def _complete_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -419,7 +454,7 @@ class RoutingService:
             else:
                 self._resolve(batch, results, groups)
                 if t_disp:
-                    self._record_match(t_disp, n)
+                    self._record_match(t_disp, n, batch)
             finally:
                 self.inflight -= 1
                 self._pipe_sem.release()

@@ -26,6 +26,7 @@ from rmqtt_tpu.broker.fitter import Limits
 from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.inflight import InInflight, MomentStatus, OutEntry, OutInflight
 from rmqtt_tpu.broker.queue import DeliverQueue, Policy
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import (
     ConnectInfo,
     Message,
@@ -64,6 +65,10 @@ class DeliverItem:
     # loop passes one dict per message): QoS0 subscribers on the same
     # protocol version reuse identical wire bytes instead of re-encoding
     wire_cache: dict = field(default_factory=dict)
+    # active trace of the publish that fanned this item out
+    # (broker/tracing.py): the deliver loop runs in another task, so the
+    # context rides the item instead of the contextvar
+    trace: object = None
 
 
 class Session:
@@ -429,6 +434,12 @@ class SessionState:
     async def _deliver(self, item: DeliverItem) -> None:
         s = self.s
         msg = item.msg
+        # per-subscriber delivery span — only when the publish's trace is
+        # actually recording (sampled, or already slow-promoted): unsampled
+        # and disabled deliveries take no timestamps here
+        tr = item.trace
+        t_tr = (time.perf_counter_ns()
+                if tr is not None and (tr.sampled or tr.slow) else 0)
         expired = await self.ctx.hooks.fire(
             HookType.MESSAGE_EXPIRY_CHECK, s.id, msg, initial=msg.is_expired()
         )
@@ -457,6 +468,7 @@ class SessionState:
                 OutEntry(
                     packet_id, msg, item.qos, subscription_ids=item.sub_ids,
                     retain=item.retain, wire_props=dict(props),
+                    trace=item.trace,
                 )
             )
         # QoS0 fan-out fast path: for subscribers of the same protocol
@@ -480,6 +492,10 @@ class SessionState:
                 data = cache[key] = self.codec.encode(pub)
             await self.send_raw(data)
             self.ctx.metrics.inc("messages.delivered")
+            if t_tr:
+                item.trace.add("deliver.send", t_tr,
+                               time.perf_counter_ns() - t_tr,
+                               {"client": s.client_id, "qos": 0})
             await self.ctx.hooks.fire(HookType.MESSAGE_DELIVERED, s.id, msg, None)
             return
         # outbound topic alias AFTER the drop checks: an alias must never be
@@ -506,6 +522,9 @@ class SessionState:
         )
         await self.send(pub)
         self.ctx.metrics.inc("messages.delivered")
+        if t_tr:
+            item.trace.add("deliver.send", t_tr, time.perf_counter_ns() - t_tr,
+                           {"client": s.client_id, "qos": item.qos})
         await self.ctx.hooks.fire(HookType.MESSAGE_DELIVERED, s.id, msg, None)
 
     async def _retry_loop(self) -> None:
@@ -605,15 +624,17 @@ class SessionState:
     def _record_ack_rtt(self, e: OutEntry) -> None:
         """QoS1/2 ack round trip: last (re)delivery → PUBACK/PUBCOMP. Uses
         the inflight entry's ``sent_at`` stamp, so a retried delivery
-        measures from its retransmission — the client-visible latency."""
+        measures from its retransmission — the client-visible latency.
+        A traced publish gets the same duration as its final span (acks
+        land in another task, so the trace ref rides the inflight entry)."""
         tele = self.ctx.telemetry
         if tele.enabled:
-            tele.record(
-                "deliver.ack_rtt",
-                int((time.monotonic() - e.sent_at) * 1e9),
-                {"topic": e.msg.topic, "qos": e.qos,
-                 "client": self.s.client_id},
-            )
+            dur = int((time.monotonic() - e.sent_at) * 1e9)
+            detail = {"topic": e.msg.topic, "qos": e.qos,
+                      "client": self.s.client_id}
+            tele.record("deliver.ack_rtt", dur, detail, e.trace)
+            if e.trace is not None:
+                e.trace.add_wall("deliver.ack_rtt", dur, detail)
 
     async def _on_auth(self, p: pk.Auth) -> None:
         """v5 re-authentication over the live connection (spec §4.12: client
@@ -695,11 +716,32 @@ class SessionState:
         Records the ``publish.e2e`` stage: PUBLISH decode handed to the
         pipeline → the last local forward enqueued (cluster scatter
         included for clustered registries) — the broker's dwell time, the
-        number every perf PR reports against."""
-        t0 = time.perf_counter_ns() if self.ctx.telemetry.enabled else 0
-        accepted, reason = await self._publish_inner(p)
+        number every perf PR reports against.
+
+        Tracing (broker/tracing.py) begins here too: the trace context is
+        set for the ingress task so routing / fan-out / cluster scatter
+        stamp spans onto it, and finish() decides commit (head-sampled or
+        slow) after the e2e duration is known — sharing e2e's timestamp
+        pair, so tracing adds no clock reads to this path."""
+        ctx = self.ctx
+        t0 = time.perf_counter_ns() if ctx.telemetry.enabled else 0
+        trace = tok = None
         if t0:
-            self._rec_e2e(time.perf_counter_ns() - t0, p.topic)
+            trace = ctx.tracer.begin(p.topic)
+            if trace is not None:
+                tok = CURRENT_TRACE.set(trace)
+        try:
+            accepted, reason = await self._publish_inner(p)
+        finally:
+            if tok is not None:
+                CURRENT_TRACE.reset(tok)
+        if t0:
+            dur = time.perf_counter_ns() - t0
+            self._rec_e2e(dur, p.topic, trace)
+            if trace is not None:
+                trace.add("publish.ingress", t0, dur,
+                          {"client": self.s.client_id, "qos": p.qos})
+                ctx.tracer.finish(trace)
         return accepted, reason
 
     async def _publish_inner(self, p: pk.Publish) -> Tuple[bool, int]:

@@ -15,6 +15,7 @@ from typing import Dict, Iterable, List, Optional
 
 from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.session import DeliverItem, Session
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.router.base import Id, SubscriptionOptions
 
@@ -163,13 +164,18 @@ class SessionRegistry:
         the MQTT ingress (`session.py _publish`) rather than here, so the
         cluster registries — which override this method wholesale — share
         the same instrumentation point."""
+        # the publish ingress set the trace context for this task
+        # (broker/tracing.py); fan-out hands it to each DeliverItem so the
+        # per-subscriber deliver loops can stamp their spans
+        trace = CURRENT_TRACE.get() if self.ctx.telemetry.enabled else None
         # p2p short-circuit (shared.rs:743-769)
         if msg.target_clientid is not None:
             target = self._sessions.get(msg.target_clientid)
             if target is None:
                 return 0
             target.enqueue(
-                DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter="")
+                DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter="",
+                            trace=trace)
             )
             self._mark_forwarded(msg, msg.target_clientid)
             return 1
@@ -193,12 +199,12 @@ class SessionRegistry:
             # remote nodes over the cluster backend (round 2+)
             for rel in relations:
                 count += self._deliver_local(rel.id.client_id, rel.topic_filter,
-                                             rel.opts, msg, wire_cache)
+                                             rel.opts, msg, wire_cache, trace)
         return count
 
     def _deliver_local(
         self, client_id: str, topic_filter: str, opts: SubscriptionOptions,
-        msg: Message, wire_cache: Optional[dict] = None,
+        msg: Message, wire_cache: Optional[dict] = None, trace=None,
     ) -> int:
         session = self._sessions.get(client_id)
         if session is None:
@@ -212,6 +218,7 @@ class SessionRegistry:
                 topic_filter=topic_filter,
                 sub_ids=opts.subscription_ids,
                 wire_cache=wire_cache if wire_cache is not None else {},
+                trace=trace,
             )
         )
         self._mark_forwarded(msg, client_id)

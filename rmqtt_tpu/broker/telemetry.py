@@ -51,6 +51,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
+
 NBUCKETS = 40  # [2^0, 2^40) ns ≈ up to ~18 min; top bucket absorbs overflow
 
 # canonical broker stages (unit: ns unless listed in UNITS)
@@ -71,6 +73,24 @@ UNITS: Dict[str, str] = {"routing.batch_size": "count"}
 # recorder buffer fold threshold: big enough to amortize the fold loop,
 # small enough that a mid-burst fold stall is microseconds
 _FOLD_AT = 512
+
+
+def _slow_entry(name: str, dur_ns: int, detail: Any, trace: Any) -> dict:
+    """One slow-op ring row (cold path — only built at/over ``slow_ms``).
+    Falls back to the tracing contextvar so entries recorded in the
+    publish-ingress task gain the active trace id (broker/tracing.py);
+    cross-task recorders pass their trace explicitly."""
+    if trace is None:
+        trace = CURRENT_TRACE.get()
+    entry = {
+        "op": name,
+        "ms": round(dur_ns / 1e6, 3),
+        "ts": round(time.time(), 3),
+        "detail": detail,
+    }
+    if trace is not None:
+        entry["trace"] = trace.tid
+    return entry
 
 
 def prom_sanitize(name: str) -> str:
@@ -232,7 +252,8 @@ class Telemetry:
             h = self._h[name] = Histogram()
         return h
 
-    def record(self, name: str, dur_ns: int, detail: Any = None) -> None:
+    def record(self, name: str, dur_ns: int, detail: Any = None,
+               trace: Any = None) -> None:
         """Record one op. Callers on hot paths guard with ``self.enabled``
         (so the disabled cost is one branch); the guard here keeps
         un-guarded callers correct, not fast. The histogram update is
@@ -254,12 +275,7 @@ class Telemetry:
         h.sum += dur_ns
         # non-ns stages (batch size) are not durations: never slow-log
         if dur_ns >= self.slow_ns and name not in UNITS:
-            self.slow_ops.append({
-                "op": name,
-                "ms": round(dur_ns / 1e6, 3),
-                "ts": round(time.time(), 3),
-                "detail": detail,
-            })
+            self.slow_ops.append(_slow_entry(name, dur_ns, detail, trace))
 
     def span(self, name: str, detail: Any = None):
         """Context-manager timer; a shared no-op when disabled."""
@@ -292,7 +308,8 @@ class Telemetry:
         if rec is not None:
             return rec
         if not self.enabled:
-            rec = self._recorders[name] = lambda dur_ns, detail=None: None
+            rec = self._recorders[name] = (
+                lambda dur_ns, detail=None, trace=None: None)
             return rec
         h = self.hist(name)
         counts = h.counts
@@ -325,15 +342,10 @@ class Telemetry:
 
         self._folds[name] = fold
 
-        def rec(dur_ns: int, detail: Any = None) -> None:
+        def rec(dur_ns: int, detail: Any = None, trace: Any = None) -> None:
             append(dur_ns)
             if dur_ns >= slow_ns and is_ns:
-                slow_ops.append({
-                    "op": name,
-                    "ms": round(dur_ns / 1e6, 3),
-                    "ts": round(time.time(), 3),
-                    "detail": detail,
-                })
+                slow_ops.append(_slow_entry(name, dur_ns, detail, trace))
             if len(pending) >= _FOLD_AT:
                 fold()
 

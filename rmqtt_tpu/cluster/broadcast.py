@@ -14,11 +14,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from rmqtt_tpu.broker.hooks import HookType
 from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.transport import (
@@ -62,22 +64,33 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
     mode-specific types."""
     if mtype == M.FORWARDS_TO:
         msg = M.msg_from_wire(body["msg"])
+        # adopt the publisher's trace context (optional field, absent from
+        # untraced publishes): spans recorded here carry the SAME trace id
+        # and are stitched back by the trace API's cluster fetch
+        trace = ctx.tracer.from_wire(body.get("trace"), topic=msg.topic)
+        t_tr = time.perf_counter_ns() if trace is not None else 0
         count = 0
         recipients: List[str] = []
         if body.get("p2p"):
             target = ctx.registry.get(body["p2p"])
             if target is None:
                 raise ClusterReplyError("no-such-client")  # select_ok tries next peer
-            target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False, topic_filter=""))
+            target.enqueue(DeliverItem(msg=msg, qos=msg.qos, retain=False,
+                                       topic_filter="", trace=trace))
             count, recipients = 1, [body["p2p"]]
         else:
             wire_cache: dict = {}  # shared per inbound fan-out
             for rw in body["rels"]:
                 rel = M.relation_from_wire(rw)
                 if ctx.registry._deliver_local(rel.id.client_id, rel.topic_filter,
-                                               rel.opts, msg, wire_cache):
+                                               rel.opts, msg, wire_cache, trace):
                     count += 1
                     recipients.append(rel.id.client_id)
+        if trace is not None:
+            trace.add("cluster.remote_deliver", t_tr,
+                      time.perf_counter_ns() - t_tr,
+                      {"count": count, "node": ctx.node_id})
+            ctx.tracer.finish(trace)
         # fire-and-forget mark-forwarded ack back to the publishing node
         # (cluster-raft/src/shared.rs:596-613 ForwardsToAck); the sender's
         # node id rides in the body (the transport has no peer identity)
@@ -197,6 +210,17 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             # per-node latency histograms for /api/v1/latency/sum; buckets
             # merge by addition on the requesting node
             return {"latency": ctx.telemetry.snapshot()}
+        if what == "traces":
+            # trace-API cluster fetch (broker/tracing.py): by id → this
+            # node's spans for that trace (the requester stitches);
+            # otherwise recent/slow summaries for the merged listings
+            tid = body.get("id")
+            if tid is not None:
+                return {"trace": ctx.tracer.get(str(tid))}
+            limit = int(body.get("limit", 50))
+            if body.get("slow"):
+                return {"traces": ctx.tracer.slow_traces(limit)}
+            return {"traces": ctx.tracer.recent(limit)}
         if what == "offlines":
             from rmqtt_tpu.broker.http_api import client_info
 
@@ -296,6 +320,10 @@ class ClusterSessionRegistry(ClusterRegistryBase):
         cluster = self.cluster
         if cluster is None or not cluster.peers:
             return await super().forwards(msg)
+        # trace context set by the publish ingress (broker/tracing.py);
+        # rides every peer RPC so remote spans share the trace id
+        trace = CURRENT_TRACE.get() if self.ctx.telemetry.enabled else None
+        tw = M.trace_to_wire(trace)
         if msg.target_clientid is not None:  # p2p: local first, then peers
             if self._sessions.get(msg.target_clientid) is not None:
                 return await super().forwards(msg)
@@ -305,6 +333,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     "rels": [],
                     "p2p": msg.target_clientid,
                     "from_node": self.ctx.node_id,
+                    "trace": tw,
                 })
                 return 1
             except (PeerUnavailable, ClusterReplyError):
@@ -312,11 +341,15 @@ class ClusterSessionRegistry(ClusterRegistryBase):
         # 1) local: deliver non-shared, collect shared candidates
         raw = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
         relmap, shared = raw
-        count, _ = self._deliver_relmap(relmap, msg)
+        count, _ = self._deliver_relmap(relmap, msg, trace)
         # 2) scatter: peers deliver their non-shared and reply candidates
+        t_fw = time.perf_counter_ns() if trace is not None else 0
         replies = await cluster.bcast.join_all_call(
-            M.FORWARDS, {"msg": M.msg_to_wire(msg)}
+            M.FORWARDS, {"msg": M.msg_to_wire(msg), "trace": tw}
         )
+        if trace is not None:
+            trace.add("cluster.forward", t_fw, time.perf_counter_ns() - t_fw,
+                      {"mode": "broadcast", "peers": len(cluster.peers)})
         mgr = getattr(self.ctx, "message_mgr", None)
         merged: Dict[Tuple[str, str], list] = {k: list(v) for k, v in shared.items()}
         for node_id, reply in replies:
@@ -338,8 +371,15 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                 continue
             sid, opts, _ = cands[idx]
             rel = SubRelation(tf, sid, opts)
+            if trace is not None:
+                # zero-duration marker: WHO won the cluster-global
+                # round-robin for this publish (the decision, not a stage)
+                trace.add_wall("shared.choice", 0, {
+                    "group": group, "filter": tf,
+                    "node": sid.node_id, "client": sid.client_id})
             if sid.node_id == self.ctx.node_id:
-                count += self._deliver_local(sid.client_id, tf, opts, msg)
+                count += self._deliver_local(sid.client_id, tf, opts, msg,
+                                             trace=trace)
             else:
                 remote_targets.setdefault(sid.node_id, []).append(rel)
         for node_id, rels in remote_targets.items():
@@ -352,6 +392,7 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     "rels": [M.relation_to_wire(r) for r in rels],
                     "p2p": None,
                     "from_node": self.ctx.node_id,
+                    "trace": tw,
                 })
                 count += len(rels)
                 self.ctx.metrics.inc("cluster.forwards")
@@ -359,14 +400,14 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                 log.warning("ForwardsTo to node %s failed", node_id)
         return count
 
-    def _deliver_relmap(self, relmap, msg: Message) -> Tuple[int, List[str]]:
+    def _deliver_relmap(self, relmap, msg: Message, trace=None) -> Tuple[int, List[str]]:
         count = 0
         recipients: List[str] = []
         wire_cache: dict = {}  # shared per fan-out (frame reuse)
         for _node, rels in relmap.items():
             for rel in rels:
                 if self._deliver_local(rel.id.client_id, rel.topic_filter,
-                                       rel.opts, msg, wire_cache):
+                                       rel.opts, msg, wire_cache, trace):
                     count += 1
                     recipients.append(rel.id.client_id)
         return count, recipients
@@ -445,9 +486,25 @@ class BroadcastCluster:
         if mtype == M.FORWARDS:
             # scatter-gather: deliver local non-shared, reply shared candidates
             msg = M.msg_from_wire(body["msg"])
-            raw = await ctx.routing.matches_raw(msg.from_id, msg.topic)
-            relmap, shared = raw
-            count, recipients = ctx.registry._deliver_relmap(relmap, msg)
+            # adopt the publisher's trace for THIS node's spans (the
+            # contextvar makes the local routing queue/match stages stamp
+            # them; trace id comes off the wire, so the publisher's trace
+            # API fetch stitches the remote hop in)
+            trace = ctx.tracer.from_wire(body.get("trace"), topic=msg.topic)
+            tok = CURRENT_TRACE.set(trace) if trace is not None else None
+            t_tr = time.perf_counter_ns() if trace is not None else 0
+            try:
+                raw = await ctx.routing.matches_raw(msg.from_id, msg.topic)
+                relmap, shared = raw
+                count, recipients = ctx.registry._deliver_relmap(relmap, msg, trace)
+            finally:
+                if tok is not None:
+                    CURRENT_TRACE.reset(tok)
+            if trace is not None:
+                trace.add("cluster.remote_match", t_tr,
+                          time.perf_counter_ns() - t_tr,
+                          {"count": count, "node": ctx.node_id})
+                ctx.tracer.finish(trace)
             return {"count": count, "shared": _cands_to_wire(shared),
                     "recipients": recipients if msg.stored_id is not None else []}
         res = await handle_common_message(ctx, mtype, body, cluster=self, from_node=_from_node)

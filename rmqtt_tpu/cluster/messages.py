@@ -77,6 +77,16 @@ def msg_from_wire(d: dict) -> Message:
     )
 
 
+def trace_to_wire(trace) -> Optional[list]:
+    """Optional trace-context field riding the FORWARDS / FORWARDS_TO
+    bodies (broker/tracing.py): ``[trace_id_hex, sampled]``. ``None`` (or
+    an absent key) means "untraced" — receivers MUST treat the field as
+    optional so frames from nodes without tracing keep decoding; the
+    receiving node adopts the id via ``Tracer.from_wire`` so spans recorded
+    there stitch back to the publisher's trace."""
+    return None if trace is None else [trace.tid, bool(trace.sampled)]
+
+
 def opts_to_wire(o: SubscriptionOptions) -> list:
     return [o.qos, o.no_local, o.retain_as_published, o.retain_handling,
             list(o.subscription_ids), o.shared_group]

@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from rmqtt_tpu.broker.session import DeliverItem
 from rmqtt_tpu.broker.shared import SessionRegistry
 from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import HandshakeLockedError, Message
 from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.broadcast import (
@@ -121,13 +123,17 @@ class RaftSessionRegistry(ClusterRegistryBase):
         c = self.cluster
         if c is None or not c.peers:
             return await super().forwards(msg)
+        # trace context from the publish ingress (broker/tracing.py); rides
+        # the targeted ForwardsTo so the owning nodes' spans stitch back
+        trace = CURRENT_TRACE.get() if self.ctx.telemetry.enabled else None
+        tw = M.trace_to_wire(trace)
         if msg.target_clientid is not None:
             if self._sessions.get(msg.target_clientid) is not None:
                 return await super().forwards(msg)
             try:
                 await c.bcast.select_ok(M.FORWARDS_TO, {
                     "msg": M.msg_to_wire(msg), "rels": [], "p2p": msg.target_clientid,
-                    "from_node": self.ctx.node_id,
+                    "from_node": self.ctx.node_id, "trace": tw,
                 })
                 return 1
             except (PeerUnavailable, ClusterReplyError):
@@ -140,7 +146,8 @@ class RaftSessionRegistry(ClusterRegistryBase):
         for node_id, rels in relmap.items():
             if node_id == self.ctx.node_id:
                 for rel in rels:
-                    count += self._deliver_local(rel.id.client_id, rel.topic_filter, rel.opts, msg, wire_cache)
+                    count += self._deliver_local(rel.id.client_id, rel.topic_filter,
+                                                 rel.opts, msg, wire_cache, trace)
             else:
                 remote.setdefault(node_id, []).extend(rels)
         # shared groups: all candidates are in the replicated table — choose
@@ -157,10 +164,16 @@ class RaftSessionRegistry(ClusterRegistryBase):
             if idx is None:
                 continue
             sid, opts, _ = cands[idx]
+            if trace is not None:
+                trace.add_wall("shared.choice", 0, {
+                    "group": group, "filter": tf,
+                    "node": sid.node_id, "client": sid.client_id})
             if sid.node_id == my_node:
-                count += self._deliver_local(sid.client_id, tf, opts, msg)
+                count += self._deliver_local(sid.client_id, tf, opts, msg,
+                                             trace=trace)
             else:
                 remote.setdefault(sid.node_id, []).append(SubRelation(tf, sid, opts))
+        t_fw = time.perf_counter_ns() if (trace is not None and remote) else 0
         for node_id, rels in remote.items():
             peer = c.peers.get(node_id)
             if peer is None:
@@ -171,11 +184,15 @@ class RaftSessionRegistry(ClusterRegistryBase):
                     "rels": [M.relation_to_wire(r) for r in rels],
                     "p2p": None,
                     "from_node": self.ctx.node_id,
+                    "trace": tw,
                 })
                 count += len(rels)
                 self.ctx.metrics.inc("cluster.forwards")
             except PeerUnavailable:
                 log.warning("raft ForwardsTo to node %s failed", node_id)
+        if t_fw:
+            trace.add("cluster.forward", t_fw, time.perf_counter_ns() - t_fw,
+                      {"mode": "raft", "nodes": sorted(remote)})
         return count
 
 

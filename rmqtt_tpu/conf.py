@@ -77,12 +77,16 @@ def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
 @dataclass
 class LogConfig:
     """The ``[log]`` section (`rmqtt-conf/src/logging.rs` Log struct):
-    destination (off/file/console/both), severity, and file placement."""
+    destination (off/file/console/both), severity, file placement, and the
+    line format — ``plain`` (human) or ``json`` (one JSON object per line
+    with level/logger/msg and, when a publish trace is in scope, its trace
+    id — so broker logs join with `/api/v1/traces`)."""
 
     to: str = "console"  # off | file | console | both
     level: str = "info"  # off | error | warn | info | debug | trace
     dir: str = "logs"  # reference default is /var/log/rmqtt; keep writable
     file: str = "rmqtt.log"
+    format: str = "plain"  # plain | json
 
     def filename(self) -> str:
         """dir + file joined (logging.rs ``Log::filename``)."""
@@ -100,9 +104,39 @@ _LOG_LEVELS = {
 }
 
 
+class _JsonLogFormatter:
+    """``[log] format = "json"``: one JSON object per line. The active
+    publish trace id (broker/tracing.py contextvar) is stamped on records
+    emitted inside a traced pipeline, so log lines and spans join on it."""
+
+    def format(self, record) -> str:
+        import json as _json
+
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        try:
+            from rmqtt_tpu.broker.tracing import CURRENT_TRACE
+
+            trace = CURRENT_TRACE.get()
+            if trace is not None:
+                out["trace"] = trace.tid
+        except Exception:
+            pass
+        if record.exc_info:
+            import logging as _logging
+
+            out["exc"] = _logging.Formatter().formatException(record.exc_info)
+        return _json.dumps(out, default=str)
+
+
 def setup_logging(log: LogConfig, verbose: bool = False) -> None:
     """Apply the ``[log]`` section to the root logger (file/console
-    handlers, severity); ``verbose`` (CLI ``-v``) forces DEBUG on top."""
+    handlers, severity, plain/json line format); ``verbose`` (CLI ``-v``)
+    forces DEBUG on top."""
     import logging
 
     root = logging.getLogger()
@@ -115,6 +149,9 @@ def setup_logging(log: LogConfig, verbose: bool = False) -> None:
     to = log.to.lower()
     if to not in ("off", "file", "console", "both"):
         raise ValueError(f"log.to must be off|file|console|both, got {log.to!r}")
+    fmt_kind = log.format.lower()
+    if fmt_kind not in ("plain", "json"):
+        raise ValueError(f"log.format must be plain|json, got {log.format!r}")
     level = _LOG_LEVELS.get(log.level.lower())
     if log.level.lower() not in _LOG_LEVELS:
         raise ValueError(f"log.level {log.level!r} not recognized")
@@ -124,8 +161,11 @@ def setup_logging(log: LogConfig, verbose: bool = False) -> None:
         root.addHandler(logging.NullHandler())
         root.setLevel(logging.CRITICAL + 1)
         return
-    fmt = logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s %(message)s")
+    if fmt_kind == "json":
+        fmt = _JsonLogFormatter()
+    else:
+        fmt = logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s")
     if to in ("console", "both"):
         h = logging.StreamHandler()
         h.setFormatter(fmt)
@@ -272,11 +312,17 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "pipeline_depth": ("routing_pipeline_depth", int),
     }, broker_kwargs)
     # [observability] — latency telemetry knobs (broker/telemetry.py):
-    # histograms + slow-op ring; enable=false makes every span a no-op
+    # histograms + slow-op ring; enable=false makes every span a no-op.
+    # trace_* configure the per-publish tracing layer (broker/tracing.py):
+    # head-sampling probability + bounded trace/span store caps (tracing
+    # shares enable and slow_ms — a slow publish is always recorded)
     _apply_section(tree, "observability", {
         "enable": ("telemetry_enable", bool),
         "slow_ms": ("telemetry_slow_ms", float),
         "slow_log_max": ("telemetry_slow_log_max", int),
+        "trace_sample": ("trace_sample", float),
+        "trace_max_traces": ("trace_max_traces", int),
+        "trace_max_spans": ("trace_max_spans", int),
     }, broker_kwargs)
 
     cluster_listen = None

@@ -40,6 +40,7 @@ from typing import List, Optional
 from rmqtt_tpu.bridge.kafka_client import EARLIEST, LATEST, KafkaClient, KafkaError
 from rmqtt_tpu.broker.codec import props as P
 from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
@@ -192,12 +193,21 @@ class BridgeEgressKafkaPlugin(Plugin):
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
+            # capture the publish's trace id in THIS task (the tracing
+            # contextvar is ingress-scoped; the drain pump is another
+            # task) — but only once a forward actually matches, so
+            # non-bridged publishes never pay the lazy 128-bit id draw.
+            # It exits as a record header joinable with /api/v1/traces.
+            trace = CURRENT_TRACE.get()
+            tid = None
             # every matching entry forwards independently (each has its own
             # remote topic/partition)
             for entry in self.forwards:
                 if match_filter(entry.get("filter", "#"), msg.topic):
+                    if tid is None and trace is not None:
+                        tid = trace.tid
                     try:
-                        self._q.put_nowait((entry, msg))
+                        self._q.put_nowait((entry, msg, tid))
                     except asyncio.QueueFull:
                         self.ctx.metrics.inc("bridge.kafka.dropped")
             return None
@@ -208,7 +218,7 @@ class BridgeEgressKafkaPlugin(Plugin):
 
     async def _drain(self) -> None:
         while True:
-            entry, msg = await self._q.get()
+            entry, msg, tid = await self._q.get()
             topic = entry.get("remote_topic", msg.topic.replace("/", "."))
             partition = int(entry.get("partition", -1))
             key = None
@@ -216,6 +226,8 @@ class BridgeEgressKafkaPlugin(Plugin):
                 if uk == MESSAGE_KEY:
                     key = uv.encode()
             headers = [("mqtt_topic", msg.topic.encode())]
+            if tid is not None:
+                headers.append(("mqtt_trace_id", tid.encode()))
             try:
                 if partition < 0:  # PARTITION_UNASSIGNED: round-robin
                     parts = await self._client.partitions(topic)

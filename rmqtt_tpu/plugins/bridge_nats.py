@@ -19,6 +19,7 @@ from rmqtt_tpu.bridge.nats_client import (
     nats_to_mqtt_topic,
 )
 from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
@@ -90,8 +91,13 @@ class BridgeEgressNatsPlugin(Plugin):
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
             if any(match_filter(f, msg.topic) for f in self.filters):
+                # trace id captured in the ingress task (the drain pump is
+                # another task); rides out as a NATS header when the
+                # server supports them
+                trace = CURRENT_TRACE.get()
                 try:
-                    self._q.put_nowait(msg)
+                    self._q.put_nowait(
+                        (msg, trace.tid if trace is not None else None))
                 except asyncio.QueueFull:
                     self.ctx.metrics.inc("bridge.nats.dropped")
             return None
@@ -102,10 +108,11 @@ class BridgeEgressNatsPlugin(Plugin):
 
     async def _drain(self) -> None:
         while True:
-            msg: Message = await self._q.get()
+            msg, tid = await self._q.get()
             await self._client.connected.wait()
             ok = await self._client.publish(
-                self.subject_prefix + mqtt_to_nats_subject(msg.topic), msg.payload
+                self.subject_prefix + mqtt_to_nats_subject(msg.topic), msg.payload,
+                headers=[("Mqtt-Trace-Id", tid)] if tid is not None else None,
             )
             self.ctx.metrics.inc("bridge.nats.forwarded" if ok else "bridge.nats.errors")
 

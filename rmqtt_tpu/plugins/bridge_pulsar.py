@@ -39,6 +39,7 @@ from typing import List, Optional
 from rmqtt_tpu.bridge.pulsar_client import PulsarClient
 from rmqtt_tpu.broker.codec import props as P
 from rmqtt_tpu.broker.hooks import HookType
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
@@ -160,10 +161,18 @@ class BridgeEgressPulsarPlugin(Plugin):
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
+            # trace id captured in the ingress task, drawn only once a
+            # forward matches (non-bridged publishes skip the lazy id
+            # draw); becomes a Pulsar message property so consumers can
+            # join back to the trace API
+            trace = CURRENT_TRACE.get()
+            tid = None
             for i, entry in enumerate(self.forwards):
                 if match_filter(entry.get("filter", "#"), msg.topic):
+                    if tid is None and trace is not None:
+                        tid = trace.tid
                     try:
-                        self._q.put_nowait((i, entry, msg))
+                        self._q.put_nowait((i, entry, msg, tid))
                     except asyncio.QueueFull:
                         self.ctx.metrics.inc("bridge.pulsar.dropped")
             return None
@@ -185,8 +194,10 @@ class BridgeEgressPulsarPlugin(Plugin):
 
     async def _drain(self) -> None:
         while True:
-            i, entry, msg = await self._q.get()
+            i, entry, msg, tid = await self._q.get()
             props = [("mqtt_topic", msg.topic)]
+            if tid is not None:
+                props.append(("mqtt_trace_id", tid))
             if entry.get("forward_all_from", True) and msg.from_id is not None:
                 props.append(("from_node", str(msg.from_id.node_id)))
                 props.append(("from_clientid", msg.from_id.client_id))

@@ -112,6 +112,7 @@ class SysTopicPlugin(Plugin):
                 f"{self._prefix}/metrics", json.dumps(self.ctx.metrics.to_json()).encode()
             )
             await self._publish_latency()
+            await self._publish_tracing()
             await asyncio.sleep(self.interval)
 
     async def _publish_latency(self) -> None:
@@ -136,4 +137,21 @@ class SysTopicPlugin(Plugin):
             await self._publish(
                 f"{self._prefix}/latency/slow_ops",
                 json.dumps(snap["slow_ops"]).encode(),
+            )
+
+    async def _publish_tracing(self) -> None:
+        """$SYS/brokers/<node>/tracing/#: the tracer's counters/config
+        under ``tracing/stats`` and the latest slow-trace summaries under
+        ``tracing/slow`` (ids are fetchable via /api/v1/traces/<id>)."""
+        tracer = getattr(self.ctx, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return
+        await self._publish(
+            f"{self._prefix}/tracing/stats",
+            json.dumps(tracer.snapshot()).encode(),
+        )
+        slow = tracer.slow_traces(10)
+        if slow:
+            await self._publish(
+                f"{self._prefix}/tracing/slow", json.dumps(slow).encode()
             )

@@ -337,7 +337,8 @@ def test_routing_service_batch_dedup_and_raw_waiters():
         # so they arrive as one batch
         futs = [asyncio.get_running_loop().create_future() for _ in range(8)]
         for i, fut in enumerate(futs):
-            await svc._q.put((None, "hot/t", fut, i % 2 == 1, 0))
+            # queue items are 6-tuples since tracing: (..., t0, trace)
+            await svc._q.put((None, "hot/t", fut, i % 2 == 1, 0, None))
         svc.start()
         try:
             results = await asyncio.gather(*futs)

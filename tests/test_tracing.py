@@ -1,0 +1,403 @@
+"""Distributed per-publish tracing tests (broker/tracing.py + surfaces).
+
+Four tiers:
+- Tracer unit semantics: head sampling, always-record-on-slow (including
+  LATE promotion by a slow tail span), bounded store/span caps, stitch.
+- Live single broker: traced publishes produce complete span chains
+  (ingress → queue wait → match → deliver → QoS1 ack) retrievable from
+  /api/v1/traces; slow-op ring entries carry trace ids; sampling off and
+  disabled modes record nothing (the disabled contract is PINNED: begin()
+  returns None, zero allocations/counters).
+- Two-node in-proc cluster: a publish forwarded across nodes yields ONE
+  trace id whose spans cover both nodes, stitched by /api/v1/traces/<id>
+  on EITHER node.
+- Config/log satellites: [observability] trace keys, [log] format=json
+  (with the active trace id in the line), uptime/build-info exposition.
+"""
+
+import asyncio
+import json
+import logging
+
+from rmqtt_tpu.broker.codec import packets as pk
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.http_api import HttpApi
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.broker.tracing import CURRENT_TRACE, Tracer
+from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+
+from tests.mqtt_client import TestClient
+from tests.test_http_plugins import http_get
+from tests.test_telemetry import broker_test
+
+T0 = 1_000_000  # arbitrary perf_counter_ns-domain origin for unit tests
+
+
+# ------------------------------------------------------------------- tracer
+
+def test_tracer_head_sampling_and_store():
+    tr = Tracer(enabled=True, sample=1.0, max_traces=8, slow_ms=1e9)
+    t = tr.begin("a/b")
+    assert t is not None and len(t.tid) == 32
+    t.add("publish.ingress", T0, 5_000, {"qos": 1})
+    t.add("routing.match", T0 + 1_000, 2_000, None)
+    tr.finish(t)
+    assert tr.traces_recorded == 1 and tr.spans_recorded == 2
+    got = tr.get(t.tid)
+    assert got is not None and got["trace_id"] == t.tid
+    assert [s["name"] for s in got["spans"]] == ["publish.ingress", "routing.match"]
+    assert got["topic"] == "a/b" and got["nodes"] == [1]
+    assert got["dur_ms"] > 0
+    # late span (another task, post-finish) still lands on the record
+    t.add("deliver.ack_rtt", T0 + 4_000, 1_000, None)
+    assert len(tr.get(t.tid)["spans"]) == 3
+    # summaries
+    assert tr.recent(10)[0]["trace_id"] == t.tid
+    assert tr.slow_traces(10) == []
+
+
+def test_tracer_sampled_out_and_slow_promotion():
+    tr = Tracer(enabled=True, sample=0.0, max_traces=8, slow_ms=1.0)
+    # fast publish at sample=0: dropped, nothing stored
+    t = tr.begin("fast/t")
+    t.add("publish.ingress", T0, 10_000)  # 10us < 1ms threshold
+    tr.finish(t)
+    assert tr.traces_sampled_out == 1 and len(tr.store) == 0
+    # slow span → recorded despite sample=0 (always-record-on-slow)
+    t2 = tr.begin("slow/t")
+    t2.add("publish.ingress", T0, 5_000_000)  # 5ms
+    tr.finish(t2)
+    assert t2.slow and tr.get(t2.tid) is not None
+    assert tr.get(t2.tid)["slow"] is True
+    assert tr.slow_traces(10)[0]["trace_id"] == t2.tid
+    # LATE promotion: finish drops the trace, then a slow tail span (e.g.
+    # a QoS1 ack RTT recorded in the read-loop task) resurrects it. Fast
+    # spans that PRECEDED the stall are not retained on unsampled traces
+    # (the one-compare hot path) — the slow span and its aftermath are.
+    t3 = tr.begin("late/t")
+    t3.add("publish.ingress", T0, 1_000)  # fast + unsampled: dropped
+    tr.finish(t3)
+    assert tr.get(t3.tid) is None
+    t3.add_wall("deliver.ack_rtt", 7_000_000)  # 7ms — slow
+    got = tr.get(t3.tid)
+    assert got is not None and got["slow"]
+    assert [s["name"] for s in got["spans"]] == ["deliver.ack_rtt"]
+
+
+def test_committed_trace_late_slow_flag():
+    """A slow tail span landing AFTER a sampled trace committed (e.g. a
+    200ms ack on a fast-committed publish) must flip the stored slow flag
+    so the slow-only listings surface it."""
+    tr = Tracer(enabled=True, sample=1.0, slow_ms=1.0)
+    t = tr.begin("x/y")
+    t.add("publish.ingress", T0, 1_000)  # fast
+    tr.finish(t)
+    assert tr.get(t.tid)["slow"] is False
+    t.add_wall("deliver.ack_rtt", 5_000_000)  # 5ms late slow ack
+    got = tr.get(t.tid)
+    assert got["slow"] is True
+    assert [s["name"] for s in got["spans"]] == ["publish.ingress",
+                                                 "deliver.ack_rtt"]
+    assert tr.slow_traces(5)[0]["trace_id"] == t.tid
+
+
+def test_tracer_bounds_and_disabled():
+    tr = Tracer(enabled=True, sample=1.0, max_traces=2, max_spans=3, slow_ms=1e9)
+    tids = []
+    for i in range(3):
+        t = tr.begin(f"t/{i}")
+        for j in range(5):  # 2 over the span cap
+            t.add("s", T0 + j, 10)
+        tr.finish(t)
+        tids.append(t.tid)
+    assert len(tr.store) == 2 and tr.traces_dropped == 1
+    assert tr.get(tids[0]) is None  # FIFO-evicted
+    assert len(tr.get(tids[2])["spans"]) == 3
+    assert tr.spans_dropped == 3 * 2
+    # disabled: begin/from_wire return None, nothing allocates
+    off = Tracer(enabled=False)
+    assert off.begin("x") is None
+    assert off.from_wire(["ab" * 16, True]) is None
+    snap = off.snapshot()
+    assert snap["enabled"] is False and snap["stored_traces"] == 0
+
+
+def test_tracer_from_wire_and_merge():
+    a = Tracer(enabled=True, sample=1.0, node_id=1, slow_ms=1e9)
+    b = Tracer(enabled=True, sample=1.0, node_id=2, slow_ms=1e9)
+    t = a.begin("x/y")
+    t.add("publish.ingress", T0, 100)
+    a.finish(t)
+    from rmqtt_tpu.cluster.messages import trace_to_wire
+
+    assert trace_to_wire(None) is None
+    remote = b.from_wire(trace_to_wire(t), topic="x/y")
+    assert remote.tid == t.tid and remote.sampled
+    remote.add("cluster.remote_deliver", T0 + 50, 60)
+    b.finish(remote)
+    merged = Tracer.merge_traces([a.get(t.tid), b.get(t.tid)])
+    assert merged["trace_id"] == t.tid
+    assert merged["nodes"] == [1, 2]
+    assert [s["node"] for s in merged["spans"]] == [1, 2]  # time-sorted
+    # summary dedup for the cluster-merged recent listing
+    rows = Tracer.dedup_summaries(a.recent(5) + b.recent(5))
+    assert len(rows) == 1 and rows[0]["nodes"] == [1, 2] and rows[0]["spans"] == 2
+
+
+# -------------------------------------------------------------- live broker
+
+async def _traffic(broker, n=4, prefix="tr"):
+    sub = await TestClient.connect(broker.port, f"{prefix}-sub", version=pk.V5)
+    await sub.subscribe(f"{prefix}/#", qos=1)
+    publ = await TestClient.connect(broker.port, f"{prefix}-pub", version=pk.V5)
+    for i in range(n):
+        await publ.publish(f"{prefix}/{i}", b"x", qos=1)
+    for _ in range(n):
+        await sub.recv()
+    await asyncio.sleep(0.1)  # let acks/spans land
+    return sub, publ
+
+
+@broker_test(trace_sample=1.0)
+async def test_trace_api_end_to_end(broker, api):
+    await _traffic(broker)
+    status, body = await http_get(api.bound_port, "/api/v1/traces")
+    assert status == 200
+    listing = json.loads(body)
+    assert listing["enabled"] is True and listing["sample"] == 1.0
+    assert listing["traces"], "sampled publishes must be listed"
+    row = listing["traces"][0]
+    tid = row["trace_id"]
+    status, body = await http_get(api.bound_port, f"/api/v1/traces/{tid}")
+    assert status == 200
+    trace = json.loads(body)
+    names = [s["name"] for s in trace["spans"]]
+    # the full chain: ingress, batcher queue wait + match (distinct-topic
+    # publishes are cache misses), per-subscriber delivery, QoS1 ack
+    for want in ("publish.ingress", "routing.queue_wait", "routing.match",
+                 "publish.cache_miss", "deliver.send", "deliver.ack_rtt"):
+        assert want in names, (want, names)
+    # spans are time-sorted and the envelope brackets them
+    starts = [s["start_ns"] for s in trace["spans"]]
+    assert starts == sorted(starts)
+    assert trace["nodes"] == [1] and trace["dur_ms"] >= 0
+    # ingress contains the queue wait (same timestamp base)
+    by = {s["name"]: s for s in trace["spans"]}
+    assert by["routing.queue_wait"]["dur_ns"] <= by["publish.ingress"]["dur_ns"]
+    # unknown id → 404
+    status, _ = await http_get(api.bound_port, "/api/v1/traces/" + "0" * 32)
+    assert status == 404
+    # prometheus: tracing counters present, _total-suffixed
+    status, body = await http_get(api.bound_port, "/metrics/prometheus")
+    text = body.decode()
+    assert "# TYPE rmqtt_tracing_spans_recorded_total counter" in text
+    assert "rmqtt_tracing_stored_traces" in text
+    assert "# TYPE rmqtt_uptime_seconds gauge" in text
+    assert "rmqtt_build_info{" in text
+
+
+@broker_test(trace_sample=0.0, telemetry_slow_ms=0.0)
+async def test_trace_slow_promotion_live(broker, api):
+    """sample=0 but slow_ms=0: every publish is 'slow', so every publish is
+    traced anyway — and slow-op ring entries carry the trace id."""
+    await _traffic(broker)
+    status, body = await http_get(api.bound_port, "/api/v1/traces/slow")
+    slow = json.loads(body)
+    assert slow["traces"], "slow publishes must be recorded at sample=0"
+    assert all(r["slow"] for r in slow["traces"])
+    # the ring log gained trace ids (joining the two views)
+    status, body = await http_get(api.bound_port, "/api/v1/latency")
+    ops = json.loads(body)["slow_ops"]
+    traced_ops = [op for op in ops if "trace" in op]
+    assert traced_ops, "slow-op ring entries must carry trace ids"
+    tids = {r["trace_id"] for r in slow["traces"]}
+    assert any(op["trace"] in tids for op in traced_ops)
+
+
+@broker_test(trace_sample=0.0)
+async def test_trace_sampling_off(broker, api):
+    """sample=0 with the default (100ms) slow threshold: local-loopback
+    publishes are fast → every trace is sampled out, store stays empty."""
+    await _traffic(broker)
+    tracer = broker.ctx.tracer
+    assert len(tracer.store) == 0 and tracer.traces_recorded == 0
+    assert tracer.traces_sampled_out >= 4
+    status, body = await http_get(api.bound_port, "/api/v1/traces")
+    listing = json.loads(body)
+    assert listing["traces"] == [] and listing["traces_sampled_out"] >= 4
+
+
+@broker_test(telemetry_enable=False, trace_sample=1.0)
+async def test_trace_disabled_records_nothing(broker, api):
+    """[observability] enable=false pins the disabled contract: begin()
+    returns None (no ids, no span allocations, no timestamps) and the API
+    stays shape-stable."""
+    tracer = broker.ctx.tracer
+    assert tracer.begin("any/topic") is None
+    await _traffic(broker)
+    assert len(tracer.store) == 0
+    assert tracer.traces_recorded == 0 and tracer.traces_sampled_out == 0
+    assert tracer.spans_recorded == 0 and tracer.spans_dropped == 0
+    status, body = await http_get(api.bound_port, "/api/v1/traces")
+    listing = json.loads(body)
+    assert status == 200 and listing["enabled"] is False
+    assert listing["traces"] == []
+    status, _ = await http_get(api.bound_port, "/api/v1/traces/" + "0" * 32)
+    assert status == 404
+
+
+# ---------------------------------------------------------- two-node cluster
+
+def test_cross_node_trace_stitch():
+    """A QoS1 publish on node 2 delivered via a cluster forward to a
+    subscriber on node 1 yields ONE trace (one id) whose spans cover
+    ingress + routing on node 2, the cluster forward, and remote
+    match/delivery/ack on node 1 — retrievable from /api/v1/traces/<id>
+    on EITHER node."""
+
+    async def make_node(node_id):
+        ctx = ServerContext(BrokerConfig(
+            port=0, node_id=node_id, cluster=True, trace_sample=1.0))
+        broker = MqttBroker(ctx)
+        await broker.start()
+        api = HttpApi(ctx, port=0)
+        await api.start()
+        return broker, api
+
+    async def run():
+        from rmqtt_tpu.cluster.transport import PeerClient
+
+        (b1, api1), (b2, api2) = await make_node(1), await make_node(2)
+        clusters = []
+        for b in (b1, b2):
+            c = BroadcastCluster(b.ctx, ("127.0.0.1", 0), [])
+            await c.start()
+            clusters.append(c)
+        for i, c in enumerate(clusters):
+            other = clusters[1 - i]
+            nid = (b2 if i == 0 else b1).ctx.node_id
+            c.peers[nid] = PeerClient(nid, "127.0.0.1", other.bound_port)
+            c.bcast.peers = list(c.peers.values())
+        try:
+            sub = await TestClient.connect(b1.port, "stitch-sub", version=pk.V5)
+            await sub.subscribe("stitch/#", qos=1)
+            publ = await TestClient.connect(b2.port, "stitch-pub", version=pk.V5)
+            await publ.publish("stitch/t", b"hop", qos=1)
+            p = await sub.recv()
+            assert p.payload == b"hop"
+            await asyncio.sleep(0.3)  # remote delivery + ack spans land
+
+            # publisher node lists the trace
+            _, body = await http_get(api2.bound_port, "/api/v1/traces")
+            rows = [r for r in json.loads(body)["traces"]
+                    if r["topic"] == "stitch/t"]
+            assert len(rows) == 1, "one publish → one trace id"
+            tid = rows[0]["trace_id"]
+
+            for api in (api1, api2):  # stitched fetch works from EITHER node
+                status, body = await http_get(
+                    api.bound_port, f"/api/v1/traces/{tid}")
+                assert status == 200
+                trace = json.loads(body)
+                assert trace["trace_id"] == tid
+                assert trace["nodes"] == [1, 2], trace
+                names = [s["name"] for s in trace["spans"]]
+                by_node = {s["name"]: s["node"] for s in trace["spans"]}
+                # ingress + routing on the publishing node
+                assert by_node["publish.ingress"] == 2
+                assert "routing.queue_wait" in names and "routing.match" in names
+                # the hop itself, recorded on node 2
+                assert by_node["cluster.forward"] == 2
+                # remote match + delivery + QoS1 ack on node 1
+                assert by_node["cluster.remote_match"] == 1
+                assert by_node["deliver.send"] == 1
+                assert by_node["deliver.ack_rtt"] == 1
+            # the remote node also lists the same id (no second trace)
+            _, body = await http_get(api1.bound_port, "/api/v1/traces")
+            remote_rows = [r for r in json.loads(body)["traces"]
+                           if r["topic"] == "stitch/t"]
+            assert {r["trace_id"] for r in remote_rows} == {tid}
+            assert remote_rows[0]["nodes"] == [1, 2]
+        finally:
+            for c in clusters:
+                await c.stop()
+            for api in (api1, api2):
+                await api.stop()
+            for b in (b1, b2):
+                await b.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+# ------------------------------------------------------------ conf satellites
+
+def test_conf_trace_keys(tmp_path):
+    from rmqtt_tpu import conf
+
+    p = tmp_path / "tr.toml"
+    p.write_text(
+        "[observability]\nenable = true\ntrace_sample = 0.25\n"
+        "trace_max_traces = 99\ntrace_max_spans = 17\n"
+    )
+    s = conf.load(str(p))
+    assert s.broker.trace_sample == 0.25
+    assert s.broker.trace_max_traces == 99
+    assert s.broker.trace_max_spans == 17
+    bad = tmp_path / "bad.toml"
+    bad.write_text("[observability]\ntrace_nope = 1\n")
+    try:
+        conf.load(str(bad))
+    except ValueError as e:
+        assert "observability" in str(e)
+    else:
+        raise AssertionError("unknown [observability] key must raise")
+
+
+def test_conf_log_format_json(tmp_path):
+    from rmqtt_tpu import conf
+    from rmqtt_tpu.conf import LogConfig, _JsonLogFormatter, setup_logging
+
+    p = tmp_path / "lg.toml"
+    p.write_text('[log]\nto = "console"\nformat = "json"\n')
+    s = conf.load(str(p))
+    assert s.log.format == "json"
+    try:
+        setup_logging(LogConfig(to="console", format="nope"))
+    except ValueError as e:
+        assert "format" in str(e)
+    else:
+        raise AssertionError("bad log.format must raise")
+    # json lines carry level/logger/msg — and the active trace id when a
+    # publish trace is in scope
+    fmt = _JsonLogFormatter()
+    rec = logging.LogRecord("rmqtt_tpu.x", logging.WARNING, __file__, 1,
+                            "slow %s", ("thing",), None)
+    out = json.loads(fmt.format(rec))
+    assert out["level"] == "WARNING" and out["logger"] == "rmqtt_tpu.x"
+    assert out["msg"] == "slow thing" and "trace" not in out
+    tr = Tracer(enabled=True, sample=1.0)
+    t = tr.begin("a/b")
+    tok = CURRENT_TRACE.set(t)
+    try:
+        out = json.loads(fmt.format(rec))
+        assert out["trace"] == t.tid
+    finally:
+        CURRENT_TRACE.reset(tok)
+    # restore the test session's logging (setup_logging replaced handlers)
+    setup_logging(LogConfig(to="off"))
+
+
+@broker_test()
+async def test_uptime_monotonic_and_stats_shape(broker, api):
+    """Uptime satellite: both /stats surfaces report a monotonic-based
+    uptime; Stats.to_json rounds float gauges (shape-stable JSON)."""
+    status, body = await http_get(api.bound_port, "/api/v1/brokers")
+    broker_row = json.loads(body)[0]
+    assert 0 <= broker_row["uptime"] < 60
+    status, body = await http_get(api.bound_port, "/api/v1/nodes")
+    node_row = json.loads(body)[0]
+    assert 0 <= node_row["uptime"] < 60
+    stats = broker.ctx.stats().to_json()
+    for k, v in stats.items():
+        if isinstance(v, float):
+            assert v == round(v, 3), (k, v)
