@@ -851,6 +851,196 @@ def run_telemetry_config(name, rng, reduced):
     return res
 
 
+def run_overload_config(name, rng, reduced):
+    """Config 8: overload soak (broker/overload.py) — a QoS0 publisher
+    outruns a paced subscriber 10:1 through a real broker, controller OFF
+    vs ON.
+
+    OFF: the slow consumer's deliver queue grows toward its (large) cap for
+    the whole soak, and the surviving traffic's e2e latency is dominated by
+    queue dwell — the throughput-cliff shape the edge-broker benchmark
+    study attributes to unmanaged queue growth. ON: the watermark machine
+    trips ELEVATED, QoS0 to the backlogged consumer is shed at the slow-
+    consumer fraction, the queue stays pinned near the shed threshold, and
+    delivered messages keep a bounded p99. Records goodput, shed counts by
+    reason, peak queue depth and delivered-traffic p50/p99 for both runs."""
+    import asyncio
+    import struct
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.fitter import FitterConfig
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    pub_rate = 1000 if reduced else 2000  # publisher msgs/s
+    sub_rate = pub_rate / 10.0  # subscriber paced 10:1 behind
+    soak_s = 3.0 if reduced else 6.0
+    mqueue = 10_000  # large cap: OFF-run growth is visible, not clipped early
+    # ~1KB frames: the 10:1 deficit (several MB over the soak) must exceed
+    # what kernel socket buffers can absorb, or the backlog never reaches
+    # the broker's deliver queue and the controller has nothing to bound
+    pad = b"p" * 1016
+
+    async def _connect(port, cid, rcvbuf=None):
+        import socket as _s
+
+        sk = _s.socket()
+        if rcvbuf:
+            # shrink the client's receive window BEFORE connect: kernel
+            # socket buffers otherwise absorb megabytes of backlog and the
+            # latency under test (broker-side queue dwell) never shows
+            sk.setsockopt(_s.SOL_SOCKET, _s.SO_RCVBUF, rcvbuf)
+        sk.setblocking(False)
+        await asyncio.get_running_loop().sock_connect(sk, ("127.0.0.1", port))
+        reader, writer = await asyncio.open_connection(sock=sk)
+        codec = MqttCodec(pk.V311)
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError("no CONNACK")
+            if codec.feed(data):
+                return reader, writer, codec
+
+    async def soak(enable):
+        kw = dict(port=0, fitter=FitterConfig(max_mqueue=mqueue, max_inflight=64))
+        if enable:
+            kw.update(
+                overload_enable=True, overload_sample_interval=0.05,
+                # aggregate occupancy over ~2 sessions * 10k cap: ELEVATED
+                # once the sub's backlog passes ~80 items. The watermark sits
+                # BELOW the shed floor (100 items = 0.005 occupancy), so while
+                # shedding holds the queue at the floor the state stays
+                # pinned ELEVATED instead of flapping through its clear band
+                overload_mqueue_elevated=0.004, overload_mqueue_critical=0.9,
+                overload_shed_slow_fraction=0.01,  # slow = >100 queued
+                overload_hold=2,
+            )
+        b = MqttBroker(ServerContext(BrokerConfig(**kw)))
+        await b.start()
+        sid = f"c8-sub-{enable}"
+        sr, sw, sc = await _connect(b.port, sid, rcvbuf=32 * 1024)
+        sw.write(sc.encode(pk.Subscribe(1, [("ov8/#", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        # shrink the broker→subscriber send buffer too (same for both runs):
+        # the backlog must land in the broker's deliver queue, the thing the
+        # controller manages, not in invisible kernel buffering
+        import socket as _s
+
+        srv = b.ctx.registry.get(sid)
+        srv_sock = srv.state.writer.get_extra_info("socket")
+        if srv_sock is not None:
+            srv_sock.setsockopt(_s.SOL_SOCKET, _s.SO_SNDBUF, 32 * 1024)
+        pr, pw, pcodec = await _connect(b.port, f"c8-pub-{enable}")
+        lat = []
+        received = [0]
+        peak_q = [0]
+        stop = asyncio.Event()
+
+        async def sub_loop():
+            # paced consumer: sleep per processed publish → TCP backpressure
+            # stalls the broker's deliver loop, the 10:1 deficit lands in
+            # the broker-side deliver queue (the scenario under test)
+            while not stop.is_set():
+                try:
+                    data = await asyncio.wait_for(sr.read(4096), 0.25)
+                except asyncio.TimeoutError:
+                    continue
+                if not data:
+                    return
+                n = 0
+                now = time.perf_counter()
+                for p in sc.feed(data):
+                    if isinstance(p, pk.Publish):
+                        lat.append(now - struct.unpack("d", p.payload[:8])[0])
+                        n += 1
+                if n:
+                    received[0] += n
+                    await asyncio.sleep(n / sub_rate)
+
+        async def sampler():
+            while not stop.is_set():
+                s = b.ctx.registry.get(sid)
+                if s is not None:
+                    peak_q[0] = max(peak_q[0], len(s.deliver_queue))
+                await asyncio.sleep(0.05)
+
+        tasks = [asyncio.get_running_loop().create_task(sub_loop()),
+                 asyncio.get_running_loop().create_task(sampler())]
+        sent = 0
+        burst = 20
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < soak_s:
+            for _ in range(burst):
+                payload = struct.pack("d", time.perf_counter()) + pad
+                pw.write(pcodec.encode(pk.Publish(topic="ov8/t", payload=payload)))
+            sent += burst
+            await pw.drain()
+            await asyncio.sleep(burst / pub_rate)
+        elapsed = time.perf_counter() - t0
+        await asyncio.sleep(0.5)  # grace: in-flight deliveries land
+        stop.set()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        m = b.ctx.metrics.to_json()
+        ctrl = b.ctx.overload
+        res = {
+            "sent": sent,
+            "received": received[0],
+            "goodput_msgs_per_sec": round(received[0] / elapsed, 1),
+            "peak_sub_queue_depth": peak_q[0],
+            "delivered_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1) if lat else None,
+            "delivered_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1) if lat else None,
+            "dropped_by_reason": {
+                k[len("messages.dropped."):]: v for k, v in m.items()
+                if k.startswith("messages.dropped.")
+            },
+            "dropped_total": m.get("messages.dropped", 0),
+            "overload_state_final": ctrl.state.name,
+            "overload_transitions": ctrl.transitions,
+        }
+        for w in (sw, pw):
+            try:
+                w.close()
+            except Exception:
+                pass
+        await b.stop()
+        return res
+
+    off = asyncio.run(soak(False))
+    on = asyncio.run(soak(True))
+    res = {
+        "name": name,
+        "pub_rate": pub_rate,
+        "sub_rate": sub_rate,
+        "soak_s": soak_s,
+        "max_mqueue": mqueue,
+        "controller_off": off,
+        "controller_on": on,
+        # the two acceptance numbers: ON bounds the backlog (memory) and
+        # the surviving traffic's tail where OFF lets both grow all soak
+        "queue_depth_ratio_off_over_on": round(
+            off["peak_sub_queue_depth"] / max(1, on["peak_sub_queue_depth"]), 2),
+        "p99_ratio_off_over_on": round(
+            (off["delivered_p99_ms"] or 0) / max(0.001, on["delivered_p99_ms"] or 0.001), 2),
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] OFF: peak queue {off['peak_sub_queue_depth']} "
+        f"p99 {off['delivered_p99_ms']}ms goodput {off['goodput_msgs_per_sec']}/s | "
+        f"ON: peak queue {on['peak_sub_queue_depth']} "
+        f"p99 {on['delivered_p99_ms']}ms goodput {on['goodput_msgs_per_sec']}/s "
+        f"shed {on['dropped_by_reason'].get('shed_qos0', 0)} "
+        f"→ queue ratio {res['queue_depth_ratio_off_over_on']}x, "
+        f"p99 ratio {res['p99_ratio_off_over_on']}x")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -863,7 +1053,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-5")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-8")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -914,11 +1104,11 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 7
+            return i <= 8
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
-        # host-side match-result cache) and cfg7 (telemetry overhead) are
-        # cheap and always informative
-        return i <= 3 or i in (6, 7) or args.full or on_tpu
+        # host-side match-result cache), cfg7 (telemetry overhead) and cfg8
+        # (overload soak) are cheap, host-side and always informative
+        return i <= 3 or i in (6, 7, 8) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -1015,11 +1205,29 @@ def main():
 
         guarded("cfg7_telemetry_overhead", cfg7)
 
-    # cfg6/cfg7 have their own shapes (on/off comparisons, no tpu/cpu
+    if want(8):
+        def cfg8():
+            return run_overload_config("cfg8_overload_soak", rng, reduced)
+
+        guarded("cfg8_overload_soak", cfg8)
+
+    # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
-    # "telemetry_overhead" instead of the configs table
+    # "telemetry_overhead" / "overload_soak" instead of the configs table
     cache_res = results.pop("cfg6_cache_zipf", None)
     tele_res = results.pop("cfg7_telemetry_overhead", None)
+    overload_res = results.pop("cfg8_overload_soak", None)
+    if not results and overload_res is not None and tele_res is None and cache_res is None:
+        print(json.dumps({
+            "metric": "overload_p99_bound[cfg8_overload_soak]",
+            "value": overload_res["p99_ratio_off_over_on"],
+            "unit": "x_off_over_on",
+            "vs_baseline": overload_res["p99_ratio_off_over_on"],
+            "platform": platform,
+            "overload_soak": overload_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        return
     if not results and tele_res is not None and cache_res is None:
         print(json.dumps({
             "metric": "telemetry_overhead_pct[cfg7_telemetry_overhead]",
@@ -1029,6 +1237,7 @@ def main():
             "platform": platform,
             "latency_ms": tele_res["latency_ms"],
             "telemetry_overhead": tele_res,
+            **({"overload_soak": overload_res} if overload_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1042,6 +1251,7 @@ def main():
             "platform": platform,
             "route_cache": cache_res,
             **({"telemetry_overhead": tele_res} if tele_res else {}),
+            **({"overload_soak": overload_res} if overload_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1111,6 +1321,9 @@ def main():
         # enabled run) so BENCH rounds track tails, not just throughput
         **({"telemetry_overhead": tele_res,
             "latency_ms": tele_res["latency_ms"]} if tele_res is not None else {}),
+        # overload soak (cfg8): bounded-backlog + bounded-p99 evidence for
+        # the overload controller, on vs off (broker/overload.py)
+        **({"overload_soak": overload_res} if overload_res is not None else {}),
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }

@@ -109,6 +109,37 @@ class BrokerConfig:
     trace_sample: float = 0.01  # probability a publish is head-sampled
     trace_max_traces: int = 512  # committed traces kept (FIFO eviction)
     trace_max_spans: int = 64  # spans kept per trace
+    # overload-control subsystem (broker/overload.py, [overload] config
+    # section): watermark-driven NORMAL/ELEVATED/CRITICAL states, token-
+    # bucket admission, degradation tiers, circuit-broken egress. Disabled
+    # by default — enable=false is pinned to zero behavior change.
+    overload_enable: bool = False
+    overload_sample_interval: float = 1.0  # seconds between signal samples
+    overload_clear_ratio: float = 0.85  # hysteresis: clear below ratio*mark
+    overload_hold: int = 2  # consecutive clear samples before de-escalating
+    # watermarks (fractions of capacity unless noted; 0 disables a signal)
+    overload_queue_elevated: float = 0.5  # routing ingress-queue fraction
+    overload_queue_critical: float = 0.9
+    overload_mqueue_elevated: float = 0.6  # aggregate deliver-queue occupancy
+    overload_mqueue_critical: float = 0.9
+    overload_inflight_elevated: float = 0.85  # QoS1/2 window saturation
+    overload_inflight_critical: float = 0.97
+    overload_rss_elevated_mb: float = 0.0  # process RSS watermarks (MB)
+    overload_rss_critical_mb: float = 0.0
+    overload_connect_rate_elevated: float = 0.0  # handshakes/sec
+    overload_connect_rate_critical: float = 0.0
+    # admission token buckets (0 = unlimited; burst 0 = equal to the rate)
+    overload_connect_rate_limit: float = 0.0  # per listener port
+    overload_connect_burst: float = 0.0
+    overload_publish_rate_limit: float = 0.0  # per client id
+    overload_publish_burst: float = 0.0
+    # degradation knobs
+    overload_shed_slow_fraction: float = 0.5  # "slow consumer" queue fill
+    overload_batch_shrink: int = 4  # max_batch divisor at ELEVATED+
+    # circuit-breaker defaults (cluster transport + bridge producers)
+    overload_breaker_threshold: int = 5
+    overload_breaker_cooldown: float = 3.0
+    overload_breaker_max_cooldown: float = 30.0
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -214,6 +245,12 @@ class ServerContext:
         self.hs_executor = HandshakeExecutor(
             workers=self.cfg.max_handshaking, queue_max=self.cfg.max_connections
         )
+        # overload controller (broker/overload.py): constructed even when
+        # disabled so every data-plane guard is one attribute test and the
+        # breaker registry / snapshot surface always exist
+        from rmqtt_tpu.broker.overload import OverloadController
+
+        self.overload = OverloadController(self, self.cfg)
 
     @property
     def handshaking(self) -> int:
@@ -245,8 +282,10 @@ class ServerContext:
     def start(self) -> None:
         self.routing.start()
         self.delayed.start()
+        self.overload.start()
 
     async def stop(self) -> None:
+        await self.overload.stop()
         await self.routing.stop()
         await self.delayed.stop()
 
@@ -272,4 +311,11 @@ class ServerContext:
         # routing-service gauges (per-exec stats parity, context.rs:506-555)
         for k, v in self.routing.stats().items():
             setattr(s, k, v)
+        # overload gauges (broker/overload.py): state + breaker health
+        s.overload_state = int(self.overload.state)
+        s.overload_transitions = self.overload.transitions
+        s.overload_open_breakers = sum(
+            1 for b in self.overload.breakers.values()
+            if b.state != b.CLOSED
+        )
         return s

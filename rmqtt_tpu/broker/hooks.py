@@ -43,6 +43,9 @@ class HookType(enum.Enum):
     OFFLINE_MESSAGE = "offline_message"
     OFFLINE_INFLIGHT_MESSAGES = "offline_inflight_messages"
     GRPC_MESSAGE_RECEIVED = "grpc_message_received"
+    # overload-controller state change (broker/overload.py): fired with
+    # (old_state_name, new_state_name, snapshot) on every transition
+    SERVER_OVERLOAD = "server_overload"
 
 
 @dataclass

@@ -234,6 +234,7 @@ class HttpApi:
                 "/api/v1/stats", "/api/v1/stats/sum",
                 "/api/v1/metrics", "/api/v1/metrics/sum",
                 "/api/v1/latency", "/api/v1/latency/sum",
+                "/api/v1/overload",
                 "/api/v1/traces", "/api/v1/traces/slow",
                 "/api/v1/traces/{trace_id}",
                 "/api/v1/plugins", "/api/v1/plugins/{plugin}",
@@ -401,6 +402,11 @@ class HttpApi:
             # stage histograms + slow-op ring (broker/telemetry.py);
             # shape-stable with telemetry disabled (zero-count stages)
             return 200, {"node": ctx.node_id, **ctx.telemetry.snapshot()}, J
+        if path == "/api/v1/overload":
+            # overload-controller state (broker/overload.py): watermark
+            # state + signals, admission counters, shed totals, breakers;
+            # shape-stable when the subsystem is disabled
+            return 200, {"node": ctx.node_id, **ctx.overload.snapshot()}, J
         if path == "/api/v1/traces/slow":
             # slow traces cluster-wide (broker/tracing.py): per-node
             # summaries merged + deduped by trace id
@@ -589,6 +595,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 </style></head><body>
 <h1>rmqtt_tpu broker <span id="node"></span></h1><div id="err"></div>
 <div class="cards" id="stats"></div>
+<h2>Overload</h2><div class="cards" id="overload"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
 <th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
@@ -629,6 +636,17 @@ async function tick(){
   const subs=await j("/api/v1/subscriptions?_limit=50");
   document.querySelector("#subs tbody").innerHTML=subs.map(s=>
    `<tr><td>${esc(s.client_id)}</td><td>${esc(s.topic_filter)}</td><td>${esc(s.qos)}</td></tr>`).join("");
+  const ov=await j("/api/v1/overload");
+  const shed=ov.shed||{},adm=ov.admission||{},brks=ov.breakers||{};
+  document.getElementById("overload").innerHTML=
+   `<div class="card"><div class="v"${ov.state_value?' style="color:#b00020"':''}>${esc(ov.state)}</div><div class="k">state${ov.enabled?"":" (disabled)"}</div></div>`+
+   `<div class="card"><div class="v">${esc(ov.transitions??0)}</div><div class="k">transitions</div></div>`+
+   `<div class="card"><div class="v">${esc(shed.qos0??0)}</div><div class="k">shed qos0</div></div>`+
+   `<div class="card"><div class="v">${esc(shed.rate_limited??0)}</div><div class="k">rate limited</div></div>`+
+   `<div class="card"><div class="v">${esc(shed.circuit_open??0)}</div><div class="k">circuit-open drops</div></div>`+
+   `<div class="card"><div class="v">${esc(adm.connect_refused??0)}</div><div class="k">connects refused</div></div>`+
+   Object.entries(brks).map(([n,b])=>
+    `<div class="card"><div class="v"${b.state!=="closed"?' style="color:#b00020"':''}>${esc(b.state)}</div><div class="k">breaker ${esc(n)}</div></div>`).join("");
   const lat=await j("/api/v1/latency");
   const hs=lat.histograms||{};
   document.getElementById("latency").innerHTML=

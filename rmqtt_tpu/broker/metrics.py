@@ -21,6 +21,14 @@ class Metrics:
     def inc(self, name: str, n: int = 1) -> None:
         self._c[name] += n
 
+    def drop(self, reason: str, n: int = 1) -> None:
+        """Reason-labeled message drop: bumps BOTH the flat
+        ``messages.dropped`` aggregate (dashboard compatibility) and
+        ``messages.dropped.<reason>`` (``queue_full`` / ``rate_limited`` /
+        ``shed_qos0`` / ``circuit_open`` / ``expired`` / ...)."""
+        self._c["messages.dropped"] += n
+        self._c["messages.dropped." + reason] += n
+
     def get(self, name: str) -> int:
         return self._c.get(name, 0)
 
@@ -72,6 +80,13 @@ class Stats:
         self.routing_queue_wait_p99_ms = 0.0
         self.publish_e2e_p50_ms = 0.0
         self.publish_e2e_p99_ms = 0.0
+        # overload-control gauges (broker/overload.py), overwritten by
+        # ServerContext.stats(); declared for shape stability. state is
+        # 0=NORMAL 1=ELEVATED 2=CRITICAL; open breakers counts circuits
+        # currently not closed (open or half-open probing)
+        self.overload_state = 0
+        self.overload_transitions = 0
+        self.overload_open_breakers = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

@@ -35,6 +35,10 @@ class DeliverQueue(Generic[T]):
     def __len__(self) -> int:
         return len(self._q)
 
+    def occupancy(self) -> float:
+        """Queue fullness in [0, 1] (overload-controller pressure signal)."""
+        return len(self._q) / self.maxlen if self.maxlen else 0.0
+
     def push(self, item: T, policy: Policy = Policy.DROP_EARLY) -> Optional[T]:
         """Enqueue; returns the dropped item if the queue was full."""
         dropped: Optional[T] = None
@@ -69,6 +73,11 @@ class DeliverQueue(Generic[T]):
         self._last = nw
         if self._allowance < 1.0:
             await asyncio.sleep((1.0 - self._allowance) / self._rate_limit)
+            # re-anchor the accrual clock AFTER the sleep: leaving _last at
+            # the pre-sleep stamp double-counted the slept interval (once as
+            # the token this wait earned, again as elapsed time on the next
+            # call), letting the sustained rate drift to ~2x the limit
+            self._last = time.monotonic()
             self._allowance = 0.0
         else:
             self._allowance -= 1.0

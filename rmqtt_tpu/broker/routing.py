@@ -132,6 +132,11 @@ class RoutingService:
             "routing_cache_door_rejects": c.door_rejects if c is not None else 0,
         }
 
+    def queue_fraction(self) -> float:
+        """Ingress-queue fullness in [0, 1] — the overload controller's
+        routing-backlog pressure signal (broker/overload.py)."""
+        return self._q.qsize() / (self._q.maxsize or 1)
+
     def start(self) -> None:
         loop = asyncio.get_running_loop()
         if self._task is None:

@@ -362,6 +362,19 @@ class MqttBroker:
         SessionState (caller runs it), or None if refused."""
         ctx = self.ctx
         v5 = connect.protocol == pk.V5
+        # overload admission, second tier after the pre-read busy gate
+        # (broker/overload.py): CRITICAL state or an exhausted per-listener
+        # CONNECT bucket refuses with a REASON CODE the client can act on —
+        # v5 Quota Exceeded (0x97), v3 Server Unavailable (0x03) — instead
+        # of the busy gate's silent close
+        if ctx.overload.enabled:
+            sockname = writer.get_extra_info("sockname")
+            if not ctx.overload.admit_connect(sockname[1] if sockname else 0):
+                ctx.metrics.inc("handshake.refused_overload")
+                from rmqtt_tpu.broker.types import RC_QUOTA_EXCEEDED
+
+                await self._refuse(writer, codec, v5, RC_QUOTA_EXCEEDED, 3)
+                return None
         assigned_id = None
         if not connect.client_id:
             if not v5 and not connect.clean_start:

@@ -103,14 +103,35 @@ class Session:
 
     # ---------------------------------------------------------------- fanout
     def enqueue(self, item: DeliverItem) -> None:
-        """Push into the deliver queue (fan-out target, shared.rs:876-963)."""
+        """Push into the deliver queue (fan-out target, shared.rs:876-963).
+
+        Overload tier (broker/overload.py): at ELEVATED, QoS0 fan-out to a
+        SLOW consumer (queue past the shed fraction) is shed before it ever
+        lands in the queue; at CRITICAL any backlogged consumer sheds QoS0.
+        QoS1/2 keep their at-least-once path (drop policy below). Every
+        drop is reason-labeled and, when the publish is traced, stamped as
+        an ``overload.shed`` span so the trace says why it never arrived."""
         if not self.connected and self.limits.session_expiry <= 0:
-            self.ctx.metrics.inc("messages.dropped")
+            self.ctx.metrics.drop("no_session")
+            return
+        if item.qos == 0 and self.connected and self.ctx.overload.should_shed_qos0(
+            self.deliver_queue
+        ):
+            self.ctx.metrics.drop("shed_qos0")
+            if item.trace is not None:
+                item.trace.add_wall("overload.shed", 0, {
+                    "client": self.client_id, "reason": "shed_qos0",
+                    "queue": len(self.deliver_queue),
+                    "state": self.ctx.overload.state.name,
+                })
+            asyncio.get_running_loop().create_task(
+                self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, self.id, item.msg, "shed-qos0")
+            )
             return
         policy = Policy.DROP_CURRENT if item.qos == 0 and self.connected else Policy.DROP_EARLY
         dropped = self.deliver_queue.push(item, policy)
         if dropped is not None:
-            self.ctx.metrics.inc("messages.dropped")
+            self.ctx.metrics.drop("queue_full")
             asyncio.get_running_loop().create_task(
                 self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, self.id, dropped.msg, "queue-full")
             )
@@ -445,6 +466,7 @@ class SessionState:
         )
         if expired:
             self.ctx.metrics.inc("messages.expired")
+            self.ctx.metrics.drop("expired")
             await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "expired")
             return
         props: Dict[int, object] = {
@@ -540,6 +562,7 @@ class SessionState:
             await asyncio.sleep(wait)
             for e in s.out_inflight.due():
                 if not s.out_inflight.mark_retry(e):
+                    self.ctx.metrics.drop("retries_exhausted")
                     await self.ctx.hooks.fire(
                         HookType.MESSAGE_DROPPED, s.id, e.msg, "retries-exhausted"
                     )
@@ -692,11 +715,40 @@ class SessionState:
         if p.qos > self.ctx.cfg.max_qos:
             await self._disconnect_with(RC_UNSPECIFIED_ERROR)
             return
-        # QoS2 ingress dedup (session.rs:908-963)
+        # QoS2 DUP resend of an ALREADY-ACCEPTED publish answers with the
+        # dedup PUBREC before admission runs: the retransmit is not new
+        # work, and refusing it would strand its in_qos2 entry (the client
+        # abandons the flow without PUBREL, shrinking the window forever)
+        if p.qos == 2 and p.packet_id in s.in_qos2:
+            await self.send(pk.Pubrec(p.packet_id))
+            return
+        # per-client publish admission (broker/overload.py token bucket),
+        # AFTER alias resolution (the alias table must stay consistent even
+        # across refused publishes) and BEFORE the in_qos2 insert so a
+        # refused publish never occupies window state. v5 answers with
+        # Quota Exceeded (0x97) on PUBACK/PUBREC; v3 has no per-publish
+        # reason code, so the violating connection is closed.
+        ov = self.ctx.overload
+        if ov.enabled and not ov.admit_publish(s.client_id):
+            from rmqtt_tpu.broker.types import RC_QUOTA_EXCEEDED
+
+            self.ctx.metrics.drop("rate_limited")
+            await self.ctx.hooks.fire(
+                HookType.MESSAGE_DROPPED, s.id,
+                Message(topic=p.topic, payload=p.payload, qos=p.qos, from_id=s.id),
+                "rate-limited",
+            )
+            if self.codec.version == pk.V5:
+                if p.qos == 1:
+                    await self.send(pk.Puback(p.packet_id, RC_QUOTA_EXCEEDED))
+                elif p.qos == 2:
+                    await self.send(pk.Pubrec(p.packet_id, RC_QUOTA_EXCEEDED))
+                # QoS0: nothing to answer — the drop is counted and traced
+            else:
+                self._closing.set()
+            return
+        # QoS2 ingress window insert (session.rs:908-963)
         if p.qos == 2:
-            if p.packet_id in s.in_qos2:
-                await self.send(pk.Pubrec(p.packet_id))
-                return
             if not s.in_qos2.add(p.packet_id):
                 from rmqtt_tpu.broker.types import RC_RECEIVE_MAX_EXCEEDED
 
@@ -876,11 +928,17 @@ class SessionState:
             self.ctx.metrics.inc("subscribe.errors")
             return RC_UNSPECIFIED_ERROR
         await self.ctx.hooks.fire(HookType.SESSION_SUBSCRIBED, s.id, topic_filter, None)
-        # retained replay (session.rs:1344-1365; retain-handling v5 3.8.3.1)
+        # retained replay (session.rs:1344-1365; retain-handling v5 3.8.3.1).
+        # At ELEVATED+ the retained SCAN fan-out is paused (overload tier:
+        # wildcard store scans are deferrable burst work, the live publish
+        # path is not) — counted, never silently skipped.
         if group is None and self._should_send_retained(opts, is_new):
-            asyncio.get_running_loop().create_task(
-                self._send_retained(stripped, sopts)
-            )
+            if self.ctx.overload.allow_retained_scan():
+                asyncio.get_running_loop().create_task(
+                    self._send_retained(stripped, sopts)
+                )
+            else:
+                self.ctx.metrics.inc("overload.retained_scans_paused")
         return qos
 
     def _should_send_retained(self, opts: pk.SubOpts, is_new: bool) -> bool:

@@ -208,6 +208,9 @@ class SessionRegistry:
     ) -> int:
         session = self._sessions.get(client_id)
         if session is None:
+            # a relation raced a session termination: the message cannot be
+            # delivered — reason-labeled so fan-out loss is observable
+            self.ctx.metrics.drop("no_session")
             return 0
         retain = msg.retain if opts.retain_as_published else False
         session.enqueue(

@@ -397,7 +397,14 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                 count += len(rels)
                 self.ctx.metrics.inc("cluster.forwards")
             except PeerUnavailable:
-                log.warning("ForwardsTo to node %s failed", node_id)
+                # the targeted shared-sub deliveries are lost: reason-label
+                # them (circuit_open when the breaker is holding the peer
+                # off, plain unreachable otherwise)
+                reason = ("circuit_open"
+                          if peer.breaker.state != peer.breaker.CLOSED
+                          else "peer_unreachable")
+                self.ctx.metrics.drop(reason, len(rels))
+                log.warning("ForwardsTo to node %s failed (%s)", node_id, reason)
         return count
 
     def _deliver_relmap(self, relmap, msg: Message, trace=None) -> Tuple[int, List[str]]:
@@ -426,6 +433,11 @@ class BroadcastCluster:
         self.peers: Dict[int, PeerClient] = {
             nid: PeerClient(nid, host, port) for nid, host, port in peers
         }
+        # per-peer circuit breakers come FROM the overload registry so the
+        # [overload] breaker_* knobs apply to cluster transport and a dead
+        # peer is visible in /api/v1/overload and $SYS (broker/overload.py)
+        for nid, p in self.peers.items():
+            p.breaker = ctx.overload.breaker(f"cluster.peer.{nid}")
         self.bcast = Broadcaster(list(self.peers.values()))
         # "full": replicate every retain set + startup pull; "topic_only":
         # no replication, lazy per-filter fetch at subscribe time

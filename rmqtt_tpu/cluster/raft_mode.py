@@ -213,6 +213,11 @@ class RaftCluster:
         self.peers: Dict[int, PeerClient] = {
             nid: PeerClient(nid, host, port) for nid, host, port in peers
         }
+        # per-peer breakers come from the overload registry ([overload]
+        # breaker_* knobs apply; broker/overload.py): raft heartbeats to a
+        # dead peer fail fast AND show up in the API
+        for nid, p in self.peers.items():
+            p.breaker = ctx.overload.breaker(f"cluster.peer.{nid}")
         self.bcast = Broadcaster(list(self.peers.values()))
         # retain.rs:162 RetainSyncMode: Full replicates; TopicOnly fetches
         # per-filter at subscribe time (see ClusterRegistryBase.retain_load_with)

@@ -20,9 +20,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from rmqtt_tpu.broker.overload import CircuitBreaker
 from rmqtt_tpu.cluster import wire
 
 log = logging.getLogger("rmqtt_tpu.cluster")
@@ -53,39 +53,13 @@ def _frame(obj: Any) -> bytes:
     return len(data).to_bytes(4, "big") + data
 
 
-class CircuitBreaker:
-    """Open after ``threshold`` consecutive failures; half-open probe after
-    ``cooldown`` seconds (reference CircuitBreakerConfig, context.rs:585-677)."""
-
-    def __init__(self, threshold: int = 5, cooldown: float = 3.0) -> None:
-        self.threshold = threshold
-        self.cooldown = cooldown
-        self.failures = 0
-        self.opened_at: Optional[float] = None
-
-    def allow(self) -> bool:
-        if self.opened_at is None:
-            return True
-        if time.monotonic() - self.opened_at >= self.cooldown:
-            return True  # half-open probe
-        return False
-
-    def ok(self) -> None:
-        self.failures = 0
-        self.opened_at = None
-
-    def fail(self) -> None:
-        self.failures += 1
-        now = time.monotonic()
-        if self.opened_at is None:
-            if self.failures >= self.threshold:
-                self.opened_at = now
-        elif now - self.opened_at >= self.cooldown:
-            # a half-open PROBE failed: re-arm the cooldown window.
-            # Rejected-while-open attempts must NOT re-arm it — that would
-            # keep the breaker open forever under a fast retry loop (the
-            # raft heartbeat), blocking peer recovery permanently.
-            self.opened_at = now
+# The per-peer breaker is the SHARED overload-subsystem implementation
+# (broker/overload.py CircuitBreaker): closed/open/half-open with
+# exponential backoff + jitter. Same contract as the old inline breaker —
+# rejected-while-open attempts never re-arm the cooldown (a fast retry loop
+# like the raft heartbeat must not be able to hold a peer open forever) —
+# plus bounded-backoff probing and snapshot() for /api/v1/overload; the
+# import above keeps `transport.CircuitBreaker` a valid name for callers.
 
 
 class PeerClient:

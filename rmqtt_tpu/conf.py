@@ -324,6 +324,33 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "trace_max_traces": ("trace_max_traces", int),
         "trace_max_spans": ("trace_max_spans", int),
     }, broker_kwargs)
+    # [overload] — the overload-control subsystem (broker/overload.py):
+    # watermark states + admission buckets + degradation tiers + breakers
+    _apply_section(tree, "overload", {
+        "enable": ("overload_enable", bool),
+        "sample_interval": ("overload_sample_interval", float),
+        "clear_ratio": ("overload_clear_ratio", float),
+        "hold": ("overload_hold", int),
+        "queue_elevated": ("overload_queue_elevated", float),
+        "queue_critical": ("overload_queue_critical", float),
+        "mqueue_elevated": ("overload_mqueue_elevated", float),
+        "mqueue_critical": ("overload_mqueue_critical", float),
+        "inflight_elevated": ("overload_inflight_elevated", float),
+        "inflight_critical": ("overload_inflight_critical", float),
+        "rss_elevated_mb": ("overload_rss_elevated_mb", float),
+        "rss_critical_mb": ("overload_rss_critical_mb", float),
+        "connect_rate_elevated": ("overload_connect_rate_elevated", float),
+        "connect_rate_critical": ("overload_connect_rate_critical", float),
+        "connect_rate_limit": ("overload_connect_rate_limit", float),
+        "connect_burst": ("overload_connect_burst", float),
+        "publish_rate_limit": ("overload_publish_rate_limit", float),
+        "publish_burst": ("overload_publish_burst", float),
+        "shed_slow_fraction": ("overload_shed_slow_fraction", float),
+        "batch_shrink": ("overload_batch_shrink", int),
+        "breaker_threshold": ("overload_breaker_threshold", int),
+        "breaker_cooldown": ("overload_breaker_cooldown", float),
+        "breaker_max_cooldown": ("overload_breaker_max_cooldown", float),
+    }, broker_kwargs)
 
     cluster_listen = None
     raft_db = None

@@ -32,6 +32,14 @@ from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import DEFAULT_CHUNK, match_packed_impl
 from rmqtt_tpu.utils.devfetch import fetch
 
+# shard_map moved homes across jax releases: stable `jax.shard_map` (new)
+# vs `jax.experimental.shard_map.shard_map` (older, incl. the installed
+# 0.4.x). Both accept the same mesh/in_specs/out_specs keywords.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map
+
 
 def make_mesh(devices=None, dp: int = 1, fp: Optional[int] = None) -> Mesh:
     """Build a (dp, fp) mesh over the given (or all) devices."""
@@ -70,7 +78,7 @@ class ShardedMatcher:
         tspec = (P("dp", None), P("dp"), P("dp"))
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=fspec + tspec,
             out_specs=(P("dp", "fp"), P("dp")),
@@ -150,7 +158,7 @@ class ShardedPartitionedMatcher:
         axes = ("dp", "fp")
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=self.mesh,
             in_specs=(P(), P(axes, None), P(axes), P(axes), P(axes, None)),
             out_specs=P(axes),

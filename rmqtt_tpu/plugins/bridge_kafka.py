@@ -185,14 +185,23 @@ class BridgeEgressKafkaPlugin(Plugin):
         self._pump: Optional[asyncio.Task] = None
         self._unhooks = []
         self._rr = 0
+        self.breaker = None  # set in start() from the overload registry
 
     async def start(self) -> None:
         self._client = KafkaClient(self.servers, client_id=f"rmqtt-out-{self.ctx.node_id}")
         self._q = asyncio.Queue(maxsize=self.max_queue)
+        # circuit-broken producer (broker/overload.py): a dead Kafka stops
+        # costing a connect timeout per queued record; buffered work stays
+        # bounded by the queue and overflow drops are reason-labeled
+        self.breaker = self.ctx.overload.breaker("bridge.kafka")
         self._pump = asyncio.get_running_loop().create_task(self._drain())
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
+            # CRITICAL overload: bridge egress is non-essential plugin work
+            if not self.ctx.overload.allow_noncritical():
+                self.ctx.metrics.inc("bridge.kafka.paused")
+                return None
             # capture the publish's trace id in THIS task (the tracing
             # contextvar is ingress-scoped; the drain pump is another
             # task) — but only once a forward actually matches, so
@@ -210,6 +219,8 @@ class BridgeEgressKafkaPlugin(Plugin):
                         self._q.put_nowait((entry, msg, tid))
                     except asyncio.QueueFull:
                         self.ctx.metrics.inc("bridge.kafka.dropped")
+                        if self.breaker.state != self.breaker.CLOSED:
+                            self.ctx.metrics.drop("circuit_open")
             return None
 
         self._unhooks = [
@@ -219,6 +230,9 @@ class BridgeEgressKafkaPlugin(Plugin):
     async def _drain(self) -> None:
         while True:
             entry, msg, tid = await self._q.get()
+            # open circuit: park (bounded by the queue) until the next
+            # half-open probe window instead of paying a timeout per item
+            await self.breaker.wait_ready()
             topic = entry.get("remote_topic", msg.topic.replace("/", "."))
             partition = int(entry.get("partition", -1))
             key = None
@@ -239,10 +253,12 @@ class BridgeEgressKafkaPlugin(Plugin):
                     topic, msg.payload, key=key, partition=partition,
                     headers=headers, timestamp_ms=int(time.time() * 1000),
                 )
+                self.breaker.ok()
                 self.ctx.metrics.inc("bridge.kafka.forwarded")
             except asyncio.CancelledError:
                 raise
             except (KafkaError, ConnectionError, OSError) as e:
+                self.breaker.fail()
                 log.warning("kafka egress %s: %s", topic, e)
                 self.ctx.metrics.inc("bridge.kafka.errors")
 
@@ -259,4 +275,5 @@ class BridgeEgressKafkaPlugin(Plugin):
         return True
 
     def attrs(self):
-        return {"servers": self.servers, "entries": len(self.forwards)}
+        return {"servers": self.servers, "entries": len(self.forwards),
+                "breaker": self.breaker.state if self.breaker else None}

@@ -88,11 +88,15 @@ class BridgeEgressMqttPlugin(Plugin):
         self._q: Optional[asyncio.Queue] = None
         self._pump: Optional[asyncio.Task] = None
         self._unhooks = []
+        self.breaker = None  # set in start() from the overload registry
 
     async def start(self) -> None:
         self._client = MqttClient(self.remote_host, self.remote_port, self.client_id)
         self._client.start()
         self._q = asyncio.Queue(maxsize=self.max_queue)
+        # circuit-broken producer (broker/overload.py): a dead upstream
+        # broker fails fast; overflow drops while open are reason-labeled
+        self.breaker = self.ctx.overload.breaker("bridge.mqtt")
         self._pump = asyncio.get_running_loop().create_task(self._drain())
 
         async def on_publish(_ht, args, prev):
@@ -100,11 +104,16 @@ class BridgeEgressMqttPlugin(Plugin):
             # don't loop our own bridged-in messages back out
             if msg.from_id is not None and msg.from_id.client_id == self.client_id:
                 return None
+            if not self.ctx.overload.allow_noncritical():
+                self.ctx.metrics.inc("bridge.egress.paused")
+                return None
             if any(match_filter(f, msg.topic) for f in self.filters):
                 try:
                     self._q.put_nowait(msg)
                 except asyncio.QueueFull:
                     self.ctx.metrics.inc("bridge.egress.dropped")
+                    if self.breaker.state != self.breaker.CLOSED:
+                        self.ctx.metrics.drop("circuit_open")
             return None
 
         self._unhooks = [
@@ -114,14 +123,27 @@ class BridgeEgressMqttPlugin(Plugin):
     async def _drain(self) -> None:
         while True:
             msg: Message = await self._q.get()
-            await self._client.connected.wait()
+            # bounded connect wait that FEEDS the breaker: a dead upstream
+            # must open the circuit, not park the pump forever with it
+            # closed (connected.wait() alone never returns then)
+            while True:
+                await self.breaker.wait_ready()
+                if self._client.connected.is_set():
+                    break
+                try:
+                    await asyncio.wait_for(self._client.connected.wait(), 3.0)
+                    break
+                except asyncio.TimeoutError:
+                    self.breaker.fail()
             ok = await self._client.publish(
                 self.remote_prefix + msg.topic, msg.payload, qos=min(msg.qos, 1),
                 retain=msg.retain,
             )
             if ok:
+                self.breaker.ok()
                 self.ctx.metrics.inc("bridge.egress.forwarded")
             else:
+                self.breaker.fail()
                 self.ctx.metrics.inc("bridge.egress.errors")
 
     async def stop(self) -> bool:

@@ -81,15 +81,23 @@ class BridgeEgressNatsPlugin(Plugin):
         self._q: Optional[asyncio.Queue] = None
         self._pump: Optional[asyncio.Task] = None
         self._unhooks = []
+        self.breaker = None  # set in start() from the overload registry
 
     async def start(self) -> None:
         self._client = NatsClient(self.host, self.port)
         self._client.start()
         self._q = asyncio.Queue(maxsize=self.max_queue)
+        # circuit-broken producer (broker/overload.py): repeated publish
+        # failures open the circuit and the pump backs off instead of
+        # spinning; overflow drops while open are reason-labeled
+        self.breaker = self.ctx.overload.breaker("bridge.nats")
         self._pump = asyncio.get_running_loop().create_task(self._drain())
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
+            if not self.ctx.overload.allow_noncritical():
+                self.ctx.metrics.inc("bridge.nats.paused")
+                return None
             if any(match_filter(f, msg.topic) for f in self.filters):
                 # trace id captured in the ingress task (the drain pump is
                 # another task); rides out as a NATS header when the
@@ -100,6 +108,8 @@ class BridgeEgressNatsPlugin(Plugin):
                         (msg, trace.tid if trace is not None else None))
                 except asyncio.QueueFull:
                     self.ctx.metrics.inc("bridge.nats.dropped")
+                    if self.breaker.state != self.breaker.CLOSED:
+                        self.ctx.metrics.drop("circuit_open")
             return None
 
         self._unhooks = [
@@ -109,11 +119,26 @@ class BridgeEgressNatsPlugin(Plugin):
     async def _drain(self) -> None:
         while True:
             msg, tid = await self._q.get()
-            await self._client.connected.wait()
+            # the connect wait is BOUNDED and counts as a breaker failure:
+            # an indefinitely-down remote must open the circuit (a bare
+            # connected.wait() would park here forever with it closed)
+            while True:
+                await self.breaker.wait_ready()
+                if self._client.connected.is_set():
+                    break
+                try:
+                    await asyncio.wait_for(self._client.connected.wait(), 3.0)
+                    break
+                except asyncio.TimeoutError:
+                    self.breaker.fail()
             ok = await self._client.publish(
                 self.subject_prefix + mqtt_to_nats_subject(msg.topic), msg.payload,
                 headers=[("Mqtt-Trace-Id", tid)] if tid is not None else None,
             )
+            if ok:
+                self.breaker.ok()
+            else:
+                self.breaker.fail()
             self.ctx.metrics.inc("bridge.nats.forwarded" if ok else "bridge.nats.errors")
 
     async def stop(self) -> bool:

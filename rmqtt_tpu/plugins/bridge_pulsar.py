@@ -154,13 +154,20 @@ class BridgeEgressPulsarPlugin(Plugin):
         self._pump: Optional[asyncio.Task] = None
         self._unhooks = []
         self._seq = itertools.count(1)
+        self.breaker = None  # set in start() from the overload registry
 
     async def start(self) -> None:
         self._q = asyncio.Queue(maxsize=self.max_queue)
+        # circuit-broken producer (broker/overload.py): a dead Pulsar fails
+        # fast between probes; overflow drops while open are reason-labeled
+        self.breaker = self.ctx.overload.breaker("bridge.pulsar")
         self._pump = asyncio.get_running_loop().create_task(self._drain())
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
+            if not self.ctx.overload.allow_noncritical():
+                self.ctx.metrics.inc("bridge.pulsar.paused")
+                return None
             # trace id captured in the ingress task, drawn only once a
             # forward matches (non-bridged publishes skip the lazy id
             # draw); becomes a Pulsar message property so consumers can
@@ -175,6 +182,8 @@ class BridgeEgressPulsarPlugin(Plugin):
                         self._q.put_nowait((i, entry, msg, tid))
                     except asyncio.QueueFull:
                         self.ctx.metrics.inc("bridge.pulsar.dropped")
+                        if self.breaker.state != self.breaker.CLOSED:
+                            self.ctx.metrics.drop("circuit_open")
             return None
 
         self._unhooks = [
@@ -195,6 +204,7 @@ class BridgeEgressPulsarPlugin(Plugin):
     async def _drain(self) -> None:
         while True:
             i, entry, msg, tid = await self._q.get()
+            await self.breaker.wait_ready()
             props = [("mqtt_topic", msg.topic)]
             if tid is not None:
                 props.append(("mqtt_trace_id", tid))
@@ -210,10 +220,12 @@ class BridgeEgressPulsarPlugin(Plugin):
                     i + 1, next(self._seq), msg.payload, properties=props,
                     partition_key=entry.get("partition_key") or None,
                 )
+                self.breaker.ok()
                 self.ctx.metrics.inc("bridge.pulsar.forwarded")
             except asyncio.CancelledError:
                 raise
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                self.breaker.fail()
                 log.warning("pulsar egress: %s", e)
                 self.ctx.metrics.inc("bridge.pulsar.errors")
                 await asyncio.sleep(self.reconnect_delay)

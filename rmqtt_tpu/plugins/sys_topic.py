@@ -103,16 +103,22 @@ class SysTopicPlugin(Plugin):
 
     async def _loop(self) -> None:
         while True:
-            stats = self.ctx.stats()
-            await self._publish(f"{self._prefix}/version", __version__.encode(), retain=True)
-            await self._publish(
-                f"{self._prefix}/stats", json.dumps(stats.to_json()).encode()
-            )
-            await self._publish(
-                f"{self._prefix}/metrics", json.dumps(self.ctx.metrics.to_json()).encode()
-            )
-            await self._publish_latency()
-            await self._publish_tracing()
+            # overload tier (broker/overload.py): at ELEVATED the periodic
+            # status fan-out is deferrable work and pauses; the overload
+            # topics themselves keep publishing — they're the diagnostic an
+            # operator needs exactly then
+            if self.ctx.overload.allow_sys():
+                stats = self.ctx.stats()
+                await self._publish(f"{self._prefix}/version", __version__.encode(), retain=True)
+                await self._publish(
+                    f"{self._prefix}/stats", json.dumps(stats.to_json()).encode()
+                )
+                await self._publish(
+                    f"{self._prefix}/metrics", json.dumps(self.ctx.metrics.to_json()).encode()
+                )
+                await self._publish_latency()
+                await self._publish_tracing()
+            await self._publish_overload()
             await asyncio.sleep(self.interval)
 
     async def _publish_latency(self) -> None:
@@ -137,6 +143,24 @@ class SysTopicPlugin(Plugin):
             await self._publish(
                 f"{self._prefix}/latency/slow_ops",
                 json.dumps(snap["slow_ops"]).encode(),
+            )
+
+    async def _publish_overload(self) -> None:
+        """$SYS/brokers/<node>/overload/#: ``overload/state`` carries the
+        watermark state + signals + admission/shed counters, ``overload/
+        breakers`` the circuit registry. Published only when the subsystem
+        is enabled (enable=false must change nothing, incl. $SYS)."""
+        ov = getattr(self.ctx, "overload", None)
+        if ov is None or not ov.enabled:
+            return
+        snap = ov.snapshot()
+        breakers = snap.pop("breakers", {})
+        await self._publish(
+            f"{self._prefix}/overload/state", json.dumps(snap).encode()
+        )
+        if breakers:
+            await self._publish(
+                f"{self._prefix}/overload/breakers", json.dumps(breakers).encode()
             )
 
     async def _publish_tracing(self) -> None:
