@@ -1041,6 +1041,184 @@ def run_overload_config(name, rng, reduced):
     return res
 
 
+def run_churn_config(name, rng, reduced):
+    """Config 9: churn soak — sustained subscribe/unsubscribe concurrent
+    with the cfg3 publish mix through the partitioned matcher.
+
+    Three legs:
+      free   — no churn: the baseline match p50/p99;
+      churn  — K mutations between every batch, DELTA refresh (the
+               tentpole): per-mutation upload bytes must be O(dirty
+               chunks), and p99 must hold within ~2x of the free leg;
+      full   — same churn with delta uploads disabled: every mutation
+               costs a full table repack + re-upload (the pre-delta
+               cliff this PR removes), measured for the comparison.
+    Emits upload_bytes_per_mutation + the delta-vs-full reduction factor
+    into the bench JSON (acceptance: ≥10x at the bench table size)."""
+    from rmqtt_tpu.ops.partitioned import PartitionedMatcher, pack_device_rows
+
+    n, nt, bs = (50_000, 4_096, 512) if reduced else (100_000, 6_144, 1024)
+    muts_per_batch = 16
+    filters = gen_mixed(rng, n)
+    topics = gen_topics_uniform(rng, nt)
+    log(f"[{name}] {n} subs, churn {muts_per_batch} ops/batch, batch {bs}")
+    table, fids = build_tpu_table(filters, "partitioned")
+    matcher = make_matcher(table)
+    # a reserve of fresh filters so churn adds are as varied as the table
+    fset = set(filters)
+    reserve = [f for f in gen_mixed(rng, n // 10) if f not in fset]
+    # live fid pool for O(1) random removal (swap-pop) — a list(fids) per
+    # mutation would put O(table) host work inside the measured loop
+    fid_pool = list(fids)
+    batches = [topics[i : i + bs] for i in range(0, len(topics), bs)]
+    batches = [b for b in batches if len(b) == bs]
+
+    def _measure(leg_batches, mutate):
+        lat = []
+        mutations = 0
+        bytes0 = matcher.upload_bytes
+        t0 = time.perf_counter()
+        for b in leg_batches:
+            mutations += mutate()
+            t1 = time.perf_counter()
+            matcher.match(b)
+            lat.append(time.perf_counter() - t1)
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return {
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2),
+            "topics_per_sec": round(len(leg_batches) * bs / wall, 1),
+            "mutations": mutations,
+            "mutation_rate_per_sec": round(mutations / wall, 1),
+            "upload_bytes": matcher.upload_bytes - bytes0,
+        }
+
+    def _paired_measure(leg_batches, mutate):
+        """Interleaved (churned, churn-free) matches in ONE window, order
+        alternating per pair — cfg7's order-symmetric estimator: a host-
+        noise stall lands on both series equally, so the churn-vs-free
+        ratio reflects churn cost, not scheduler luck. The churned match
+        runs right after `mutate()` (pending delta refresh); its partner
+        sees a clean table."""
+        lf: list = []
+        lc: list = []
+        ratios = []
+        mutations = 0
+        bytes0 = matcher.upload_bytes
+        t0 = time.perf_counter()
+        for i, b in enumerate(leg_batches):
+            def one(lat_list, mut):
+                nonlocal mutations
+                if mut:
+                    mutations += mutate()
+                t1 = time.perf_counter()
+                matcher.match(b)
+                lat_list.append(time.perf_counter() - t1)
+            if i % 2:
+                one(lf, False)
+                one(lc, True)
+            else:
+                one(lc, True)
+                one(lf, False)
+            ratios.append(lc[-1] / max(1e-9, lf[-1]))
+        wall = time.perf_counter() - t0
+        lf.sort()
+        lc.sort()
+        ratios.sort()
+
+        def p(lat, q):
+            return round(lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 2)
+
+        return {
+            "free_p50_ms": p(lf, 0.5), "free_p99_ms": p(lf, 0.99),
+            "p50_ms": p(lc, 0.5), "p99_ms": p(lc, 0.99),
+            "median_pair_ratio": round(ratios[len(ratios) // 2], 2),
+            "topics_per_sec": round(2 * len(leg_batches) * bs / wall, 1),
+            "mutations": mutations,
+            "mutation_rate_per_sec": round(mutations / wall, 1),
+            "upload_bytes": matcher.upload_bytes - bytes0,
+        }
+
+    def no_churn():
+        return 0
+
+    def churn():
+        k = 0
+        for _ in range(muts_per_batch // 2):
+            if reserve:
+                f = reserve.pop()
+                fid_pool.append(table.add(f))
+                fids[fid_pool[-1]] = f
+                k += 1
+            i = rng.randrange(len(fid_pool))
+            fid_pool[i], fid_pool[-1] = fid_pool[-1], fid_pool[i]
+            fid = fid_pool.pop()
+            table.remove(fid)
+            reserve.append(fids.pop(fid))
+            k += 1
+        return k
+
+    # warmup (compile) then the three legs on the same table
+    for b in batches[:2]:
+        matcher.match(b)
+    loop_batches = batches[2:]
+    while len(loop_batches) < 32:  # p99 over a handful of batches is noise
+        loop_batches = loop_batches + batches[2:]
+    free = _measure(loop_batches, no_churn)
+    # a few churned warm batches absorb the NC-regrowth recompiles (the
+    # sticky candidate-count cap crosses pow2 tiers as churn adds chunks)
+    # so the churn leg's p99 measures churn, not one-off jit flips
+    for wb in loop_batches[:4]:
+        churn()
+        matcher.match(wb)
+    d0, f0, c0 = matcher.delta_uploads, matcher.full_uploads, table.compactions
+    churn_res = _paired_measure(loop_batches, churn)
+    churn_res["delta_uploads"] = matcher.delta_uploads - d0
+    churn_res["full_uploads"] = matcher.full_uploads - f0
+    churn_res["compactions"] = table.compactions - c0
+    full_table_bytes = pack_device_rows(table).nbytes
+    per_mut = churn_res["upload_bytes"] / max(1, churn_res["mutations"])
+    churn_res["upload_bytes_per_mutation"] = round(per_mut, 1)
+    # the pre-delta cliff: disable delta uploads, every mutation → full
+    # repack + upload (fewer batches — it is exactly as slow as it sounds)
+    matcher.delta_enabled = False
+    churn()
+    matcher.match(loop_batches[0])
+    cliff = _measure(loop_batches[: max(4, len(loop_batches) // 4)], churn)
+    cliff["upload_bytes_per_mutation"] = round(
+        cliff["upload_bytes"] / max(1, cliff["mutations"]), 1)
+    matcher.delta_enabled = True
+    res = {
+        "name": name,
+        "table_size": len(fids),
+        "full_table_bytes": full_table_bytes,
+        "free": free,
+        "churn_delta": churn_res,
+        "churn_full_refresh": cliff,
+        "upload_bytes_per_mutation": churn_res["upload_bytes_per_mutation"],
+        "delta_reduction_x": round(
+            cliff["upload_bytes_per_mutation"]
+            / max(1.0, churn_res["upload_bytes_per_mutation"]), 1),
+        # within-window comparison (the paired leg's own free series), so
+        # host-load drift between legs can't fake or mask a cliff
+        "p99_churn_over_free": round(
+            churn_res["p99_ms"] / max(0.001, churn_res["free_p99_ms"]), 2),
+        "median_pair_ratio": churn_res["median_pair_ratio"],
+        "p99_full_over_free": round(
+            cliff["p99_ms"] / max(0.001, free["p99_ms"]), 2),
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] free p99 {free['p99_ms']}ms | churn(delta) p99 "
+        f"{churn_res['p99_ms']}ms ({res['p99_churn_over_free']}x in-window, "
+        f"median pair ratio {churn_res['median_pair_ratio']}x) "
+        f"{churn_res['upload_bytes_per_mutation']}B/mutation | "
+        f"churn(full) p99 {cliff['p99_ms']}ms ({res['p99_full_over_free']}x) "
+        f"{cliff['upload_bytes_per_mutation']}B/mutation → "
+        f"{res['delta_reduction_x']}x less upload traffic")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -1053,7 +1231,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-8")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-9")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -1104,11 +1282,12 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 8
+            return i <= 9
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
-        # host-side match-result cache), cfg7 (telemetry overhead) and cfg8
-        # (overload soak) are cheap, host-side and always informative
-        return i <= 3 or i in (6, 7, 8) or args.full or on_tpu
+        # host-side match-result cache), cfg7 (telemetry overhead), cfg8
+        # (overload soak) and cfg9 (churn soak / delta uploads) are cheap,
+        # host-side and always informative
+        return i <= 3 or i in (6, 7, 8, 9) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -1211,12 +1390,34 @@ def main():
 
         guarded("cfg8_overload_soak", cfg8)
 
+    if want(9):
+        def cfg9():
+            return run_churn_config("cfg9_churn_soak", rng, reduced)
+
+        guarded("cfg9_churn_soak", cfg9)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
     cache_res = results.pop("cfg6_cache_zipf", None)
     tele_res = results.pop("cfg7_telemetry_overhead", None)
     overload_res = results.pop("cfg8_overload_soak", None)
+    churn_res = results.pop("cfg9_churn_soak", None)
+    if (not results and churn_res is not None and overload_res is None
+            and tele_res is None and cache_res is None):
+        print(json.dumps({
+            "metric": "delta_upload_reduction[cfg9_churn_soak]",
+            "value": churn_res["delta_reduction_x"],
+            "unit": "x_vs_full_refresh",
+            "vs_baseline": churn_res["delta_reduction_x"],
+            "upload_bytes_per_mutation": churn_res["upload_bytes_per_mutation"],
+            "p99_churn_over_free": churn_res["p99_churn_over_free"],
+            "median_pair_ratio": churn_res["median_pair_ratio"],
+            "platform": platform,
+            "churn_soak": churn_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        return
     if not results and overload_res is not None and tele_res is None and cache_res is None:
         print(json.dumps({
             "metric": "overload_p99_bound[cfg8_overload_soak]",
@@ -1225,6 +1426,7 @@ def main():
             "vs_baseline": overload_res["p99_ratio_off_over_on"],
             "platform": platform,
             "overload_soak": overload_res,
+            **({"churn_soak": churn_res} if churn_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1238,6 +1440,7 @@ def main():
             "latency_ms": tele_res["latency_ms"],
             "telemetry_overhead": tele_res,
             **({"overload_soak": overload_res} if overload_res else {}),
+            **({"churn_soak": churn_res} if churn_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1252,6 +1455,7 @@ def main():
             "route_cache": cache_res,
             **({"telemetry_overhead": tele_res} if tele_res else {}),
             **({"overload_soak": overload_res} if overload_res else {}),
+            **({"churn_soak": churn_res} if churn_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1324,6 +1528,9 @@ def main():
         # overload soak (cfg8): bounded-backlog + bounded-p99 evidence for
         # the overload controller, on vs off (broker/overload.py)
         **({"overload_soak": overload_res} if overload_res is not None else {}),
+        # churn soak (cfg9): delta-upload traffic + p99-under-churn evidence
+        # for the churn-resilient device table (ops/partitioned.py)
+        **({"churn_soak": churn_res} if churn_res is not None else {}),
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }

@@ -77,6 +77,14 @@ class BrokerConfig:
     batch_linger_ms: float = 0.0  # 0 = latency-adaptive (no linger)
     # max routing batches past submit at once (1 = serial dispatch)
     routing_pipeline_depth: int = 3
+    # device-table churn resilience (ops/partitioned.py): incremental HBM
+    # delta uploads (scatter only dirty chunks; off = full re-upload per
+    # mutation) and background compaction (off = synchronous compact())
+    routing_delta_uploads: bool = True
+    routing_compact_async: bool = True
+    # compaction trigger: dirty_ops > max(min_ops, table_size // ratio)
+    routing_compact_min_ops: int = 1024
+    routing_compact_ratio: int = 5
     # epoch-versioned publish→relations match cache (router/cache.py):
     # repeat-topic publishes skip the matcher entirely; entries invalidate
     # by per-first-segment epochs (exact filters) / a global wildcard epoch
@@ -201,6 +209,22 @@ class ServerContext:
         # the router records its kernel.dispatch stage through the shared
         # registry (router/base.py telemetry seam)
         router.telemetry = self.telemetry
+        # device-table churn knobs ([routing] section): applied to whatever
+        # table/matcher the router owns, duck-typed so trie/native routers
+        # (no device mirror) are untouched
+        rtable = getattr(router, "table", None)
+        if rtable is not None and hasattr(rtable, "compact_async"):
+            rtable.compact_async = self.cfg.routing_compact_async
+            rtable.compact_min_ops = self.cfg.routing_compact_min_ops
+            rtable.compact_ratio = max(1, self.cfg.routing_compact_ratio)
+        rmatcher = getattr(router, "matcher", None)
+        if rmatcher is not None and hasattr(rmatcher, "delta_enabled"):
+            # AND, don't assign: the matcher's __init__ already honored the
+            # RMQTT_DELTA_UPLOADS=0 kill-switch — the TOML knob must not
+            # silently re-enable the path over an operator's env override
+            rmatcher.delta_enabled = (
+                self.cfg.routing_delta_uploads and rmatcher.delta_enabled
+            )
         self.routing = RoutingService(
             router,
             max_batch=self.cfg.batch_max,

@@ -609,7 +609,9 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "handshakings_rate","forwards","message_storages",
  "routing_cache_size","routing_cache_hits","routing_cache_misses",
  "routing_cache_invalidations","routing_cache_evictions",
- "routing_cache_door_rejects"];
+ "routing_cache_door_rejects","routing_uploads","routing_delta_uploads",
+ "routing_upload_bytes","routing_compactions","routing_compact_ms_total",
+ "routing_cand_cache_invalidations"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
 // histogram units are ns, rendered as ms)
 const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],

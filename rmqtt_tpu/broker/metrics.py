@@ -70,6 +70,15 @@ class Stats:
         self.routing_cache_invalidations = 0
         self.routing_cache_evictions = 0
         self.routing_cache_door_rejects = 0
+        # device-table lifecycle gauges (ops/partitioned.py delta uploads +
+        # background compaction), overwritten from RoutingService.stats();
+        # zeros for routers without a device mirror
+        self.routing_uploads = 0
+        self.routing_delta_uploads = 0
+        self.routing_upload_bytes = 0
+        self.routing_compactions = 0
+        self.routing_compact_ms_total = 0.0  # cumulative → summed, not averaged
+        self.routing_cand_cache_invalidations = 0
         # latency percentile gauges (broker/telemetry.py histograms),
         # overwritten from RoutingService.stats(); the `_ms` suffix marks
         # average-mode for cluster /stats/sum merging (like `_ema`) —

@@ -108,6 +108,11 @@ class RoutingService:
         def pq(name: str, q: float) -> float:
             return round(t.hist(name).quantile(q) / 1e6, 3)
 
+        # device-table lifecycle counters (router/xla.py device_stats):
+        # zeros for routers without a device mirror so the surface stays
+        # shape-stable (Prometheus/dashboard/$SYS all iterate these keys)
+        ds = getattr(self.router, "device_stats", None)
+        d = ds() if callable(ds) else {}
         return {
             # latency percentile gauges (broker/telemetry.py histograms):
             # zeros when telemetry is disabled — shape-stable either way
@@ -130,6 +135,15 @@ class RoutingService:
             "routing_cache_invalidations": c.invalidations if c is not None else 0,
             "routing_cache_evictions": c.evictions if c is not None else 0,
             "routing_cache_door_rejects": c.door_rejects if c is not None else 0,
+            # device-table churn gauges (delta uploads / bg compaction)
+            "routing_uploads": d.get("uploads", 0),
+            "routing_delta_uploads": d.get("delta_uploads", 0),
+            "routing_upload_bytes": d.get("upload_bytes", 0),
+            "routing_compactions": d.get("compactions", 0),
+            # cumulative time, so the suffix is _total (summed in
+            # /stats/sum), NOT _ms (averaged like latency percentiles)
+            "routing_compact_ms_total": d.get("compact_ms", 0.0),
+            "routing_cand_cache_invalidations": d.get("cand_cache_invalidations", 0),
         }
 
     def queue_fraction(self) -> float:
@@ -156,10 +170,13 @@ class RoutingService:
                     pass
                 setattr(self, name, None)
         # reject everything still parked in either queue — those waiters
-        # would otherwise await forever (e.g. forwards() during shutdown)
+        # would otherwise await forever (e.g. forwards() during shutdown).
+        # Destructure defensively (the batch is always item[0]): a future
+        # queue-shape change must not turn shutdown into a TypeError that
+        # strands every parked waiter
         while not self._completion_q.empty():
-            batch, _groups, _handle, _t, _n = self._completion_q.get_nowait()
-            self._reject(batch, RuntimeError("routing service stopped"))
+            item = self._completion_q.get_nowait()
+            self._reject(item[0], RuntimeError("routing service stopped"))
         while not self._q.empty():
             item = self._q.get_nowait()
             self._reject([item], RuntimeError("routing service stopped"))

@@ -310,6 +310,12 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "batch_max": ("batch_max", int),
         "linger_ms": ("batch_linger_ms", float),
         "pipeline_depth": ("routing_pipeline_depth", int),
+        # device-table churn resilience (ops/partitioned.py): incremental
+        # HBM delta uploads + background compaction trigger
+        "delta_uploads": ("routing_delta_uploads", bool),
+        "compact_async": ("routing_compact_async", bool),
+        "compact_min_ops": ("routing_compact_min_ops", int),
+        "compact_ratio": ("routing_compact_ratio", int),
     }, broker_kwargs)
     # [observability] — latency telemetry knobs (broker/telemetry.py):
     # histograms + slow-op ring; enable=false makes every span a no-op.

@@ -22,6 +22,7 @@ the host staging copy is numpy, the device copy is donated on refresh).
 
 from __future__ import annotations
 
+import bisect
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,6 +36,53 @@ UNK_TOK = 3
 _FIRST_TOK = 4
 
 _MIN_CAPACITY = 1024
+
+
+class DeltaLog:
+    """Bounded journal of dirty unit ids (rows or chunks) per table version.
+
+    Mutations append ``(version, unit)`` entries; device mirrors call
+    ``since(dev_version)`` to learn which units changed after the version
+    they hold, and scatter-write only those units to HBM instead of
+    re-uploading the whole table (the churn-resilience tentpole). The log
+    is bounded: on overflow the oldest entries drop and the *floor* rises —
+    a consumer older than the floor gets ``None`` and must full-upload.
+    ``reset()`` empties the log after a wholesale layout change (compact,
+    grow): every consumer below the new floor full-uploads anyway.
+    """
+
+    def __init__(self, max_entries: int = 65536) -> None:
+        # ONE list of (version, unit) tuples, REPLACED (never trimmed in
+        # place) on overflow: consumers snapshot the list reference once,
+        # so a concurrent trim can never shift indices under their bisect
+        # (the lockless FilterTable path reads while the event loop marks —
+        # a stale snapshot is a superset, never a hole)
+        self._e: List[Tuple[int, int]] = []
+        self._max = max_entries
+        self.floor = 0  # consumers at/above the floor may delta
+
+    def mark(self, version: int, unit: int) -> None:
+        self._e.append((version, unit))
+        if len(self._e) > self._max:
+            half = self._max // 2
+            self.floor = self._e[half - 1][0]
+            self._e = self._e[half:]
+
+    def since(self, version: int) -> Optional[List[int]]:
+        """Distinct units dirtied after ``version``; None = full upload."""
+        # snapshot BEFORE the floor check: a trim racing these two reads
+        # then either leaves us the untrimmed superset (fine) or a raised
+        # floor that fails the check (full upload — safe), never a hole
+        e = self._e  # one consistent snapshot (see __init__)
+        if version < self.floor:
+            return None
+        # entries are version-ascending: walk back to the first one > version
+        i = bisect.bisect_right(e, (version, 1 << 62))
+        return sorted({u for _v, u in e[i:]})
+
+    def reset(self, floor_version: int) -> None:
+        self._e = []
+        self.floor = floor_version
 
 
 class TokenDict:
@@ -84,6 +132,9 @@ class FilterTable:
         self.size = 0
         # bumped on every mutation; device mirrors key their cache on it
         self.version = 0
+        # dirty-row journal: device mirrors delta-upload only the rows a
+        # mutation touched (TpuMatcher._refresh) instead of the full table
+        self.delta = DeltaLog()
 
     def _alloc(self, cap: int, lvl: int) -> None:
         self.tok = np.zeros((cap, lvl), dtype=np.int32)
@@ -112,6 +163,9 @@ class FilterTable:
         if new_cap > old_cap:
             self._free = list(range(new_cap - 1, old_cap - 1, -1)) + self._free
         self.capacity, self.max_levels = new_cap, new_lvl
+        # capacity/level growth changes the device array shapes: every
+        # mirror full-uploads, so the journal can start over
+        self.delta.reset(self.version)
 
     def add(self, topic_filter: str | Sequence[str]) -> int:
         """Insert a (validated) filter; returns its row id (fid)."""
@@ -138,6 +192,7 @@ class FilterTable:
         self.row_dollar[fid] = bool(levels[0]) and is_metadata(levels[0])
         self.size += 1
         self.version += 1
+        self.delta.mark(self.version, fid)
         return fid
 
     def remove(self, fid: int) -> None:
@@ -152,6 +207,7 @@ class FilterTable:
         self._free.append(fid)
         self.size -= 1
         self.version += 1
+        self.delta.mark(self.version, fid)
 
     def encode_topics(
         self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None

@@ -32,6 +32,7 @@ reductions, which XLA fuses into a single HBM pass over the filter table.
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -40,6 +41,7 @@ import numpy as np
 from jax import lax
 
 from rmqtt_tpu.ops.encode import PLUS_TOK, FilterTable
+from rmqtt_tpu.ops.partitioned import _pad_scatter_pow2
 from rmqtt_tpu.utils.devfetch import fetch
 
 # Filters processed per scan step; bounds per-chunk HBM traffic.
@@ -294,15 +296,80 @@ class TpuMatcher:
         self.max_matches = max_matches
         self._dev_version = -1
         self._dev_arrays = None
+        # incremental refresh (same dirty-tracking as the partitioned
+        # path): mutations scatter only their rows into the resident
+        # arrays; RMQTT_DELTA_UPLOADS=0 restores full re-uploads
+        self.delta_enabled = os.environ.get("RMQTT_DELTA_UPLOADS", "1") != "0"
+        self._dev_capacity = -1
+        self._dev_lvl = -1
+        self.uploads = 0
+        self.full_uploads = 0
+        self.delta_uploads = 0
+        self.upload_bytes = 0
 
     def _refresh(self):
         t = self.table
-        if self._dev_version != t.version or self._dev_arrays is None:
-            put = functools.partial(jax.device_put, device=self.device) if self.device else jax.device_put
-            self._dev_arrays = tuple(
-                put(a) for a in (t.tok, t.flen, t.prefix_len, t.has_hash, t.first_wild)
-            )
-            self._dev_version = t.version
+        if self._dev_version == t.version and self._dev_arrays is not None:
+            return self._dev_arrays
+        # capture the version BEFORE reading journal/rows: a mutation
+        # landing mid-refresh must stay pending for the next refresh, not
+        # be marked uploaded (FilterTable has no lock; the capture makes
+        # the worst case a redundant re-upload, never a lost row)
+        version = t.version
+        # Snapshot the five array refs together and derive capacity/lvl
+        # from the captured shapes — BOTH branches read only this
+        # snapshot. Re-reading t.capacity/t.max_levels (or the live
+        # arrays) later in the refresh could interleave with a concurrent
+        # _grow: the delta gate would pass on stale shape values and then
+        # gather tiles from post-grow arrays (shape-mismatched scatter →
+        # ValueError, or out-of-range indices jax clamps onto the last
+        # row), and the full path could record post-grow capacity against
+        # pre-grow device arrays, opening the delta gate on a stale-shaped
+        # mirror. A _grow interleaving the five reads leaves mixed row
+        # counts — retry until the snapshot is shape-consistent
+        # (same-shape old/new copies differ only by the post-capture row
+        # write, whose version bump forces the next refresh anyway).
+        while True:
+            host = (t.tok, t.flen, t.prefix_len, t.has_hash, t.first_wild)
+            if all(a.shape[0] == host[0].shape[0] for a in host[1:]):
+                break
+        cap, lvl = host[0].shape
+        if (
+            self.delta_enabled
+            and self._dev_arrays is not None
+            and self._dev_capacity == cap
+            and self._dev_lvl == lvl
+        ):
+            rows = t.delta.since(self._dev_version)
+            # a fid >= cap means the journal was reset by a _grow racing
+            # this refresh (its rows live in post-grow arrays the snapshot
+            # predates) — fall through to a full upload of the snapshot;
+            # the grow's version bump forces another refresh that heals it
+            if (rows is not None and len(rows) <= cap // 2
+                    and (not rows or max(rows) < cap)):
+                if rows:
+                    idx = np.asarray(rows, dtype=np.int32)
+                    tiles = tuple(a[idx] for a in host)
+                    self.upload_bytes += sum(v.nbytes for v in tiles)
+                    # pow2-pad the scatter so steady churn reuses one
+                    # compiled shape instead of recompiling per dirty count
+                    padded = [_pad_scatter_pow2(idx, v) for v in tiles]
+                    self._dev_arrays = tuple(
+                        a.at[pi].set(pv)
+                        for a, (pi, pv) in zip(self._dev_arrays, padded)
+                    )
+                    self.uploads += 1
+                    self.delta_uploads += 1
+                self._dev_version = version
+                return self._dev_arrays
+        put = functools.partial(jax.device_put, device=self.device) if self.device else jax.device_put
+        self._dev_arrays = tuple(put(a) for a in host)
+        self._dev_version = version
+        self._dev_capacity = cap
+        self._dev_lvl = lvl
+        self.uploads += 1
+        self.full_uploads += 1
+        self.upload_bytes += sum(a.nbytes for a in host)
         return self._dev_arrays
 
     def _nchunks(self) -> int:

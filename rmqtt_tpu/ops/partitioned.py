@@ -26,8 +26,13 @@ O(F) to O(candidate rows) — the trie's pruning, with dense regular tiles.
 
 from __future__ import annotations
 
+import bisect
 import functools
-from typing import Dict, List, Optional, Sequence, Tuple
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,14 +40,116 @@ import numpy as np
 from jax import lax
 
 from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
-from rmqtt_tpu.ops.encode import _FIRST_TOK, HASH_TOK, PAD_TOK, PLUS_TOK, TokenDict, UNK_TOK
+from rmqtt_tpu.ops.encode import (
+    _FIRST_TOK,
+    HASH_TOK,
+    PAD_TOK,
+    PLUS_TOK,
+    DeltaLog,
+    TokenDict,
+    UNK_TOK,
+)
 from rmqtt_tpu.utils.devfetch import fetch
+
+# module-scope logger: _refresh/_decide_pallas sit on the dispatch path and
+# must not pay a per-call `import logging`
+_LOG = logging.getLogger("rmqtt_tpu.ops")
 
 CHUNK = 128  # rows per partition chunk (4 packed words)
 WORDS_PER_CHUNK = CHUNK // 32
 
 # partition key kinds
 _K_HASH = ("#",)
+
+
+class _CompactState:
+    """A fully-built compacted physical layout, ready to swap in."""
+
+    __slots__ = ("arrays", "fid_of_row", "row_of_fid", "cap_chunks", "nchunks",
+                 "excl_chunks", "excl_free", "shared_chunks_of",
+                 "shared_rows_of", "shared_free", "open_shared")
+
+
+def _build_compact_state(
+    key_of: Dict[int, Tuple], row_of: Dict[int, int], arrays, max_lvl: int,
+) -> _CompactState:
+    """Gather a snapshot of live rows into a fresh compacted layout.
+
+    Runs WITHOUT the table lock: ``key_of``/``row_of`` are point-in-time
+    copies and ``arrays`` are references to the then-current host arrays.
+    Rows of fids mutated after the snapshot may be read torn here — the
+    install step re-writes exactly those fids from journal data."""
+    tok_a, flen_a, pl_a, hh_a, fw_a = arrays
+    by_key: Dict[Tuple, List[int]] = {}
+    for fid, key in key_of.items():
+        by_key.setdefault(key, []).append(fid)
+    keys_sorted = sorted(by_key, key=repr)
+    src_rows: List[int] = []
+    fids_ordered: List[int] = []
+    for key in keys_sorted:
+        for fid in by_key[key]:
+            fids_ordered.append(fid)
+            src_rows.append(row_of[fid])
+    src = np.asarray(src_rows, dtype=np.int64)
+    n = len(src)
+    need_chunks = 1 + (n + CHUNK - 1) // CHUNK + 1
+    cap = 64
+    while cap < need_chunks:
+        cap *= 2
+    st = _CompactState()
+    st.cap_chunks = cap
+    rows = cap * CHUNK
+    tok = np.zeros((rows, max_lvl), dtype=np.int32)
+    flen = np.full((rows,), -1, dtype=np.int32)
+    pl = np.zeros((rows,), dtype=np.int32)
+    hh = np.zeros((rows,), dtype=bool)
+    fw = np.zeros((rows,), dtype=bool)
+    dst = np.arange(CHUNK, CHUNK + n, dtype=np.int64)  # chunk 0 stays empty
+    tok[dst] = tok_a[src, :max_lvl]
+    flen[dst] = flen_a[src]
+    pl[dst] = pl_a[src]
+    hh[dst] = hh_a[src]
+    fw[dst] = fw_a[src]
+    st.arrays = (tok, flen, pl, hh, fw)
+    fid_arr = np.asarray(fids_ordered, dtype=np.int64)
+    fid_of_row = np.full(rows, -1, dtype=np.int64)
+    fid_of_row[dst] = fid_arr
+    st.fid_of_row = fid_of_row
+    st.row_of_fid = {int(f): int(r) for f, r in zip(fid_arr, dst)}
+    # partition structures: spanned chunks per key. Partitions below one
+    # chunk stay classified as SHARED-resident so later adds keep packing
+    # instead of each claiming a fresh exclusive chunk (which would
+    # re-create the sparse layout the compaction just removed).
+    st.excl_chunks = {}
+    st.excl_free = {}
+    st.shared_chunks_of = {}
+    st.shared_rows_of = {}
+    st.shared_free = {}
+    st.open_shared = []
+    pos = CHUNK
+    for key in keys_sorted:
+        k = len(by_key[key])
+        first_chunk = pos // CHUNK
+        last_chunk = (pos + k - 1) // CHUNK
+        if k < CHUNK:
+            krows = list(range(pos, pos + k))
+            st.shared_rows_of[key] = krows
+            occ: Dict[int, int] = {}
+            for r in krows:
+                occ[r // CHUNK] = occ.get(r // CHUNK, 0) + 1
+            st.shared_chunks_of[key] = occ
+        else:
+            st.excl_chunks[key] = list(range(first_chunk, last_chunk + 1))
+        pos += k
+    st.nchunks = (pos + CHUNK - 1) // CHUNK
+    # the tail of the last chunk is unowned free space: future adds for
+    # any key fall through _alloc_row's shared path
+    tail_start = pos
+    tail_end = st.nchunks * CHUNK
+    if tail_end > tail_start:
+        st.shared_free[st.nchunks - 1] = list(range(tail_end - 1, tail_start - 1, -1))
+        st.open_shared.append(st.nchunks - 1)
+    return st
 
 
 def partition_key(levels: Sequence[str]) -> Tuple:
@@ -141,10 +248,59 @@ class PartitionedTable:
         self.size = 0
         self.version = 0
         self.dirty_ops = 0  # mutations since the last compact()
+        # --- churn resilience (delta uploads / double buffer / bg compact)
+        # one lock covers mutations, encode's layout walks, delta packing
+        # and the compaction *install*; the compaction *build* runs outside
+        # it so the dispatch path never waits on a table rebuild
+        self._mu = threading.RLock()
+        # bumped whenever the physical chunk layout changes wholesale
+        # (compact): chunk ids encoded under one epoch must never meet a
+        # device table from another
+        self.layout_epoch = 0
+        # dirty-CHUNK journal: matchers scatter-write only these chunks
+        self.delta = DeltaLog()
+        # fid-map undo journal for in-flight match handles: (version,
+        # epoch, row, old_fid) — a handle submitted at version V decodes
+        # rows through the fid map AS OF V by patching back newer writes
+        self._fid_undo_v: List[int] = []
+        self._fid_undo_e: List[int] = []
+        self._fid_undo_row: List[int] = []
+        self._fid_undo_old: List[int] = []
+        self._fid_undo_max = 65536
+        self._fid_undo_floor = 0
+        # background-compaction machinery
+        self.compact_async = True  # matcher-triggered compaction off-thread
+        self.compact_min_ops = 1024
+        self.compact_ratio = 5  # trigger above max(min_ops, size // ratio)
+        self.compactions = 0
+        self.compact_ms = 0.0
+        self.compact_aborts = 0
+        self._compacting = False
+        self._compact_thread: Optional[threading.Thread] = None
+        # serializes whole compactions (a sync compact() racing an async
+        # one must run after it, not interleave journal/install phases)
+        self._compact_lock = threading.Lock()
+        # mutation journal recorded while a compaction build is in flight:
+        # ('a', fid, key, levels) / ('r', fid, key) / ('m', fid) — replayed
+        # against the freshly built layout at install time
+        self._compact_journal: Optional[List[Tuple]] = None
+        # transient per-mutation dirty set (chunks touched by the op)
+        self._txn: Optional[List[int]] = None
+        self._undo_pending: List[Tuple[int, int]] = []
         # per-(t0[,t1[,t2]]) candidate caches: key -> (chunk ids, gid);
-        # invalidated on mutation
+        # invalidated SELECTIVELY: partition key -> cache keys consulting
+        # it, so a mutation only drops the entries it could affect
         self._cand_cache: Dict[Tuple, Tuple[np.ndarray, int]] = {}
-        self._cand_version = -1
+        self._cand_keys_of: Dict[Tuple, Set] = {}
+        self._gid_seq = 0
+        self.cand_cache_invalidations = 0
+        # size bound: selective invalidation means entries for never-mutated
+        # partitions would otherwise accumulate forever under high-
+        # cardinality publish streams; past the cap the caches (and the
+        # key registry, which also holds invalidated-entry tombstones)
+        # clear wholesale — cheap and rare
+        self.cand_cache_max = 65536
+        self._nenc_entries = 0
         # native (C++) encoder: None = not tried yet, False = unavailable
         self._nenc = None
         self._nc_cap = 32
@@ -266,6 +422,15 @@ class PartitionedTable:
         self.has_hash[dst] = self.has_hash[src]
         self.first_wild[dst] = self.first_wild[src]
         fid = int(self._fid_of_row[src])
+        if self._txn is not None:
+            # migration inside a mutation: both chunks changed on device,
+            # and both fid-map cells need undo entries for in-flight handles
+            self._txn.append(src // CHUNK)
+            self._txn.append(dst // CHUNK)
+            self._undo_pending.append((dst, int(self._fid_of_row[dst])))
+            self._undo_pending.append((src, fid))
+            if self._compact_journal is not None:
+                self._compact_journal.append(("m", fid))
         self._fid_of_row[dst] = fid
         self._row_of_fid[fid] = dst
         self._clear_row(src)
@@ -279,14 +444,112 @@ class PartitionedTable:
         self.first_wild[row] = False
         self._fid_of_row[row] = -1
 
+    # ------------------------------------------------ mutation bookkeeping
+    def _begin_txn(self) -> None:
+        self._txn = []
+        self._undo_pending: List[Tuple[int, int]] = []
+
+    def _finish_txn(self, key: Tuple) -> None:
+        """Flush one mutation's tracking: version bump, dirty-chunk marks,
+        fid-map undo entries, and selective candidate-cache invalidation."""
+        self.version += 1
+        self.dirty_ops += 1
+        v, e = self.version, self.layout_epoch
+        for cid in set(self._txn):
+            self.delta.mark(v, cid)
+        for row, old_fid in self._undo_pending:
+            self._fid_undo_v.append(v)
+            self._fid_undo_e.append(e)
+            self._fid_undo_row.append(row)
+            self._fid_undo_old.append(old_fid)
+        if len(self._fid_undo_v) > self._fid_undo_max:
+            half = self._fid_undo_max // 2
+            self._fid_undo_floor = self._fid_undo_v[half - 1]
+            del self._fid_undo_v[:half]
+            del self._fid_undo_e[:half]
+            del self._fid_undo_row[:half]
+            del self._fid_undo_old[:half]
+        self._txn = None
+        self._undo_pending = []
+        self._invalidate_cand(key)
+
+    def _invalidate_cand(self, key: Tuple) -> None:
+        """Drop only the candidate-cache entries whose partition key set
+        includes the mutated key (everything else stays warm)."""
+        cache_keys = self._cand_keys_of.pop(key, None)
+        if not cache_keys:
+            return
+        n = 0
+        cache = self._cand_cache
+        enc = self._nenc
+        for ck in cache_keys:
+            if ck[0] == "p":
+                if cache.pop(ck[1], None) is not None:
+                    n += 1
+            elif enc and enc.has_cache_del:
+                d = enc.cache_del(ck[1])
+                n += d
+                # keep the live-entry count honest or steady churn
+                # would trip the size cap with a near-empty cache
+                self._nenc_entries = max(0, self._nenc_entries - d)
+            # without rt_enc_cache_del there is nothing selective to do:
+            # _encode_native already wholesale-clears the stale cache at
+            # the next batch (cache_version != version), so a per-key
+            # clear here would just empty it N times per mutation
+        self.cand_cache_invalidations += n
+
+    def _register_cand(self, levels: Sequence[str], cache_key: Tuple) -> None:
+        """Record which partition keys a cached candidate set consulted."""
+        for key in topic_partitions(levels):
+            self._cand_keys_of.setdefault(key, set()).add(cache_key)
+
+    def fid_overlay(self, version: int, epoch: int):
+        """→ ``(overlay, ok)`` for a match handle submitted at (version,
+        epoch): ``overlay`` maps physical row → the fid it held AT that
+        version (undone past the newer in-place writes). ``ok=False`` means
+        the undo journal no longer reaches back that far — the caller must
+        decode best-effort against the live map (dropping cleared rows)."""
+        with self._mu:
+            if version >= self.version:
+                return {}, True
+            if version < self._fid_undo_floor:
+                return {}, False
+            i = bisect.bisect_right(self._fid_undo_v, version)
+            ov: Dict[int, int] = {}
+            for j in range(i, len(self._fid_undo_v)):
+                if self._fid_undo_e[j] != epoch:
+                    continue
+                row = self._fid_undo_row[j]
+                if row not in ov:  # first write after `version` wins
+                    ov[row] = self._fid_undo_old[j]
+            return ov, True
+
     # ----------------------------------------------------------------- API
     def add(self, topic_filter: str | Sequence[str]) -> int:
         levels = split_levels(topic_filter) if isinstance(topic_filter, str) else list(topic_filter)
-        nlev = len(levels)
-        if nlev > self.max_levels:
-            self._grow(self._cap_chunks, nlev)
-        key = partition_key(levels)
-        row = self._alloc_row(key)
+        with self._mu:
+            nlev = len(levels)
+            if nlev > self.max_levels:
+                self._grow(self._cap_chunks, nlev)
+            key = partition_key(levels)
+            self._begin_txn()
+            row = self._alloc_row(key)
+            self._write_row(row, levels)
+            fid = self._next_fid
+            self._next_fid += 1
+            self._key_of_fid[fid] = key
+            self._row_of_fid[fid] = row
+            self._txn.append(row // CHUNK)
+            self._undo_pending.append((row, int(self._fid_of_row[row])))
+            self._fid_of_row[row] = fid
+            self.size += 1
+            if self._compact_journal is not None:
+                self._compact_journal.append(("a", fid, key, list(levels)))
+            self._finish_txn(key)
+            return fid
+
+    def _write_row(self, row: int, levels: Sequence[str]) -> None:
+        """Fill one physical row's data from filter levels."""
         tok_row = self.tok[row]
         tok_row[:] = PAD_TOK
         for i, lev in enumerate(levels):
@@ -296,26 +559,30 @@ class PartitionedTable:
                 tok_row[i] = HASH_TOK
             else:
                 tok_row[i] = self.tokens.intern(lev)
+        nlev = len(levels)
         hh = levels[-1] == HASH
         self.flen[row] = nlev
         self.prefix_len[row] = nlev - 1 if hh else nlev
         self.has_hash[row] = hh
         self.first_wild[row] = levels[0] in (PLUS, HASH)
-        fid = self._next_fid
-        self._next_fid += 1
-        self._key_of_fid[fid] = key
-        self._row_of_fid[fid] = row
-        self._fid_of_row[row] = fid
-        self.size += 1
-        self.version += 1
-        self.dirty_ops += 1
-        return fid
 
     def remove(self, fid: int) -> None:
-        key = self._key_of_fid.pop(fid, None)
-        if key is None:
-            raise KeyError(f"fid {fid} not active")
-        row = self._row_of_fid.pop(fid)
+        with self._mu:
+            key = self._key_of_fid.pop(fid, None)
+            if key is None:
+                raise KeyError(f"fid {fid} not active")
+            self._begin_txn()
+            row = self._row_of_fid.pop(fid)
+            self._txn.append(row // CHUNK)
+            self._undo_pending.append((row, fid))
+            self._release_row(key, row)
+            self.size -= 1
+            if self._compact_journal is not None:
+                self._compact_journal.append(("r", fid, key))
+            self._finish_txn(key)
+
+    def _release_row(self, key: Tuple, row: int) -> None:
+        """Clear a physical row and return its slot to the right free list."""
         self._clear_row(row)
         cid = row // CHUNK
         occ = self._shared_chunks_of.get(key)
@@ -328,87 +595,168 @@ class PartitionedTable:
             self._free_shared_slot(row)
         else:
             self._excl_free.setdefault(key, []).append(row)
-        self.size -= 1
-        self.version += 1
-        self.dirty_ops += 1
+
+    def needs_compact(self) -> bool:
+        """Churn threshold at which the fragmented layout is worth a
+        rebuild (the former ``encode_topics`` inline trigger)."""
+        return self.dirty_ops > max(self.compact_min_ops, self.size // self.compact_ratio)
 
     def compact(self) -> None:
+        """Synchronous rebuild (build + install). In the broker this never
+        runs on the dispatch path: ``PartitionedMatcher.match_submit``
+        triggers ``maybe_compact_async()`` instead, which runs the build on
+        a background thread while matching continues against the old
+        layout, then installs atomically."""
+        th = self._compact_thread
+        if th is not None and th.is_alive() and th is not threading.current_thread():
+            th.join()  # background rebuild already in flight: let it land
+            return
+        self._compact()
+
+    def maybe_compact_async(self) -> bool:
+        """Kick off a background compaction if churn warrants one."""
+        if not self.needs_compact():
+            return False
+        with self._mu:
+            if self._compacting:
+                return False
+            self._compacting = True
+        try:
+            th = threading.Thread(
+                target=self._compact_bg, name="rmqtt-table-compact", daemon=True
+            )
+            self._compact_thread = th
+            th.start()
+        except Exception as e:
+            # thread exhaustion must not latch _compacting (disabling
+            # compaction forever) nor fail the dispatch that triggered it;
+            # the next trigger retries
+            self._compacting = False
+            _LOG.warning("background compaction thread failed to start: %s", e)
+            return False
+        return True
+
+    def _compact_bg(self) -> None:
+        try:
+            self._compact()
+        except Exception:  # pragma: no cover - defensive
+            _LOG.exception("background table compaction failed")
+        finally:
+            self._compacting = False
+
+    def _compact(self) -> None:
         """Rebuild the physical layout: each partition's rows contiguous,
         partitions packed back-to-back (boundary chunks shared between
         neighbors). Restores ~100% occupancy and minimal candidate chunk
         sets after bulk loads/churn; filter ids are stable across the move.
-        """
-        by_key: Dict[Tuple, List[int]] = {}
-        for fid, key in self._key_of_fid.items():
-            by_key.setdefault(key, []).append(fid)
-        src_rows = []
-        fids_ordered = []
-        for key in sorted(by_key, key=repr):
-            for fid in by_key[key]:
-                fids_ordered.append(fid)
-                src_rows.append(self._row_of_fid[fid])
-        src = np.asarray(src_rows, dtype=np.int64)
-        n = len(src)
-        need_chunks = 1 + (n + CHUNK - 1) // CHUNK + 1
-        # snapshot source data (may alias destination rows)
-        tok = self.tok[src].copy()
-        flen = self.flen[src].copy()
-        pl = self.prefix_len[src].copy()
-        hh = self.has_hash[src].copy()
-        fw = self.first_wild[src].copy()
-        if need_chunks > self._cap_chunks:
-            self._grow(need_chunks, self.max_levels)
-        # reset physical state
-        self.tok[:, :] = PAD_TOK
-        self.flen[:] = -1
-        self.prefix_len[:] = 0
-        self.has_hash[:] = False
-        self.first_wild[:] = False
-        self._fid_of_row[:] = -1
-        dst = np.arange(CHUNK, CHUNK + n, dtype=np.int64)  # chunk 0 stays empty
-        self.tok[dst] = tok
-        self.flen[dst] = flen
-        self.prefix_len[dst] = pl
-        self.has_hash[dst] = hh
-        self.first_wild[dst] = fw
-        fid_arr = np.asarray(fids_ordered, dtype=np.int64)
-        self._fid_of_row[dst] = fid_arr
-        self._row_of_fid = {int(f): int(r) for f, r in zip(fid_arr, dst)}
-        # rebuild partition structures: spanned chunks per key. Partitions
-        # below one chunk stay classified as SHARED-resident so later adds
-        # keep packing instead of each claiming a fresh exclusive chunk
-        # (which would re-create the sparse layout compact() just removed).
-        self._excl_chunks = {}
-        self._excl_free = {}
-        self._shared_chunks_of = {}
-        self._shared_rows_of = {}
-        self._shared_free = {}
-        self._open_shared = []
-        pos = CHUNK
-        for key in sorted(by_key, key=repr):
-            k = len(by_key[key])
-            first_chunk = pos // CHUNK
-            last_chunk = (pos + k - 1) // CHUNK
-            if k < CHUNK:
-                rows = list(range(pos, pos + k))
-                self._shared_rows_of[key] = rows
-                occ: Dict[int, int] = {}
-                for r in rows:
-                    occ[r // CHUNK] = occ.get(r // CHUNK, 0) + 1
-                self._shared_chunks_of[key] = occ
-            else:
-                self._excl_chunks[key] = list(range(first_chunk, last_chunk + 1))
-            pos += k
-        self.nchunks = (pos + CHUNK - 1) // CHUNK
-        # the tail of the last chunk is unowned free space: future adds for
-        # any key fall through _alloc_row's shared path
-        tail_start = pos
-        tail_end = self.nchunks * CHUNK
-        if tail_end > tail_start:
-            self._shared_free[self.nchunks - 1] = list(range(tail_end - 1, tail_start - 1, -1))
-            self._open_shared.append(self.nchunks - 1)
-        self.dirty_ops = 0
+
+        Two phases: the BUILD gathers a snapshot of every live row into a
+        fresh set of arrays without holding the table lock (mutations that
+        land meanwhile are journaled), then the INSTALL swaps the new
+        layout in under the lock and replays the journal. The old
+        ``_fid_of_row`` array object is left untouched, so match handles
+        submitted against the old layout keep decoding correctly."""
+        t0 = time.perf_counter()
+        with self._compact_lock:
+            with self._mu:
+                key_of = dict(self._key_of_fid)
+                row_of = dict(self._row_of_fid)
+                arrays = (self.tok, self.flen, self.prefix_len, self.has_hash,
+                          self.first_wild)
+                max_lvl = self.max_levels
+                self._compact_journal = []
+            try:
+                state = _build_compact_state(key_of, row_of, arrays, max_lvl)
+            except Exception:
+                with self._mu:
+                    self._compact_journal = None
+                raise
+            with self._mu:
+                journal = self._compact_journal or []
+                self._compact_journal = None
+                if self.max_levels != max_lvl:
+                    # a deeper filter landed mid-build: the built rows are
+                    # too narrow — abort; the next trigger rebuilds at the
+                    # new width
+                    self.compact_aborts += 1
+                    return
+                self._install_compact(state, journal)
+            self.compactions += 1
+            self.compact_ms += (time.perf_counter() - t0) * 1e3
+
+    def _install_compact(self, state: "_CompactState", journal: List[Tuple]) -> None:
+        """Swap the built layout in and replay the build-window journal.
+        Caller holds ``self._mu``."""
+        # net journal effects + row data captured from the still-live old
+        # layout (always consistent under the lock; the build-phase copies
+        # of journal-touched fids may be torn)
+        adds: Dict[int, Tuple[Tuple, List[str]]] = {}
+        removed: Dict[int, Tuple] = {}
+        moved: Dict[int, Optional[Tuple[Tuple, List[str]]]] = {}
+        for op in journal:
+            if op[0] == "a":
+                adds[op[1]] = (op[2], op[3])
+            elif op[0] == "r":
+                removed[op[1]] = op[2]
+                adds.pop(op[1], None)
+                moved.pop(op[1], None)
+            else:  # 'm': migrated by a concurrent add — data may be torn
+                if op[1] not in adds:
+                    moved[op[1]] = None
+        for fid in list(moved):
+            moved[fid] = (self._key_of_fid[fid], self._filter_of_fid(fid))
+        # atomic swap: arrays + partition maps + fid maps change together
+        (self.tok, self.flen, self.prefix_len, self.has_hash,
+         self.first_wild) = state.arrays
+        self._fid_of_row = state.fid_of_row
+        self._row_of_fid = state.row_of_fid
+        self._cap_chunks = state.cap_chunks
+        self.nchunks = state.nchunks
+        self._excl_chunks = state.excl_chunks
+        self._excl_free = state.excl_free
+        self._shared_chunks_of = state.shared_chunks_of
+        self._shared_rows_of = state.shared_rows_of
+        self._shared_free = state.shared_free
+        self._open_shared = state.open_shared
+        # replay: mutations that landed during the build
+        for fid, key in removed.items():
+            row = self._row_of_fid.pop(fid, None)
+            if row is not None:
+                self._release_row(key, row)
+        for fid, (key, levels) in adds.items():
+            row = self._alloc_row(key)
+            self._write_row(row, levels)
+            self._row_of_fid[fid] = row
+            self._fid_of_row[row] = fid
+        for fid, kl in moved.items():
+            row = self._row_of_fid.get(fid)
+            if row is not None and kl is not None:
+                self._write_row(row, kl[1])  # heal a possibly-torn copy
+        # epoch bump + invalidations land in the same locked region, so
+        # matchers can never pair stale chunk ids with the new device table
+        self.dirty_ops = len(journal)
+        self.layout_epoch += 1
         self.version += 1
+        self.delta.reset(self.version)
+        self._cand_cache.clear()
+        self._cand_keys_of.clear()
+        if self._nenc:
+            self._nenc.cache_clear()
+            self._nenc_entries = 0
+
+    def _filter_of_fid(self, fid: int) -> List[str]:
+        """Decode a live fid's filter levels back from the row data."""
+        row = self._row_of_fid[fid]
+        strs = self.tokens._strs
+        out: List[str] = []
+        for tok in self.tok[row, : int(self.flen[row])].tolist():
+            if tok == PLUS_TOK:
+                out.append(PLUS)
+            elif tok == HASH_TOK:
+                out.append(HASH)
+            else:
+                out.append(strs[tok - _FIRST_TOK])
+        return out
 
     # -------------------------------------------------------- topic encode
     def _candidates_for(self, levels: Sequence[str]) -> np.ndarray:
@@ -442,12 +790,21 @@ class PartitionedTable:
         matcher can then upload each distinct candidate row once (zipf
         publish streams share a few hot prefixes across the whole batch).
         """
-        if self.dirty_ops > max(1024, self.size // 5):
-            # heavy churn fragments the layout; rebuild before encoding so
-            # chunk ids reflect the fresh layout. In the broker this runs on
-            # the RoutingService's executor thread (routing.py dispatches
-            # matches_batch_raw via run_in_executor), not the event loop.
-            self.compact()
+        # NOTE: no inline compact() here — heavy churn used to trigger a
+        # stop-the-world rebuild on the dispatch path; compaction now runs
+        # in the background (maybe_compact_async, triggered from
+        # PartitionedMatcher.match_submit) and swaps in atomically.
+        return self.encode_topics_versioned(topics, pad_batch_to, with_groups)[0]
+
+    def encode_topics_versioned(
+        self, topics: Sequence[str | Sequence[str]],
+        pad_batch_to: Optional[int] = None, with_groups: bool = False,
+    ):
+        """``(encode tuple, layout_epoch)`` captured atomically — matchers
+        compare this epoch with their device snapshot's to detect a
+        compaction installing between encode and refresh. Returned (not
+        stashed on the table) so two matchers sharing one table can't
+        clobber each other's epoch reads."""
         if self._nenc is None:
             try:
                 from rmqtt_tpu.runtime import NativeEncoder
@@ -455,8 +812,16 @@ class PartitionedTable:
                 self._nenc = NativeEncoder()
             except (RuntimeError, OSError):
                 self._nenc = False
-        if self._nenc:
-            return self._encode_native(topics, pad_batch_to, with_groups)
+        with self._mu:
+            epoch = self.layout_epoch
+            if self._nenc:
+                return self._encode_native(topics, pad_batch_to, with_groups), epoch
+            return self._encode_py(topics, pad_batch_to, with_groups), epoch
+
+    def _encode_py(
+        self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int],
+        with_groups: bool = False,
+    ):
         batch = len(topics)
         b = pad_batch_to or batch
         lvl = self.max_levels
@@ -465,9 +830,12 @@ class PartitionedTable:
         tok_rows: List[List[int]] = []
         per_topic_chunks: List[np.ndarray] = []
         lookup = self.tokens.lookup
-        if self._cand_version != self.version:
+        # the cache is invalidated SELECTIVELY at mutation time
+        # (_invalidate_cand): entries whose partition keys a mutation never
+        # touched survive version bumps
+        if len(self._cand_cache) >= self.cand_cache_max:
             self._cand_cache.clear()
-            self._cand_version = self.version
+            self._cand_keys_of.clear()
         cache = self._cand_cache
         groups = np.full((b,), -1, dtype=np.int32)
         for j, topic in enumerate(topics):
@@ -488,8 +856,13 @@ class PartitionedTable:
             ckey = (len(ckey),) + ckey
             ent = cache.get(ckey)
             if ent is None:
-                ent = (self._candidates_for(levels), len(cache))
+                # monotonic gid (NOT len(cache)): selective invalidation
+                # means ids of evicted entries must never be reissued to a
+                # different candidate set while survivors still carry them
+                ent = (self._candidates_for(levels), self._gid_seq)
+                self._gid_seq += 1
                 cache[ckey] = ent
+                self._register_cand(levels, ("p", ckey))
             cand, gid = ent
             groups[j] = gid
             per_topic_chunks.append(cand)
@@ -523,9 +896,25 @@ class PartitionedTable:
         for i in range(enc.tokens_synced, len(toks)):
             enc.add_token(toks[i], _FIRST_TOK + i)
         enc.tokens_synced = len(toks)
-        if enc.cache_version != self.version:
+        # mutations invalidate native entries selectively at mutation time
+        # (_invalidate_cand → enc.cache_del); only a wholesale layout change
+        # (compact install) still clears the native cache. Encoders without
+        # cache_del support (stale prebuilt .so) keep the per-version clear.
+        if enc.cache_epoch != self.layout_epoch or (
+            not enc.has_cache_del and enc.cache_version != self.version
+        ):
             enc.cache_clear()
+            self._nenc_entries = 0
+            enc.cache_epoch = self.layout_epoch
             enc.cache_version = self.version
+        if self._nenc_entries >= self.cand_cache_max:
+            # size cap, applied BETWEEN batches only: rt_enc_cache_clear
+            # resets the native gid counter, so clearing mid-batch would
+            # let fresh gids collide with ones already issued to earlier
+            # topics of the same encode (aliasing the grouped upload)
+            enc.cache_clear()
+            self._nenc_entries = 0
+            self._cand_keys_of.clear()
         if batch and any(not isinstance(t, str) for t in topics):
             topics = [t if isinstance(t, str) else "/".join(t) for t in topics]
         blob = ("\x00".join(topics) + "\x00").encode() if batch else b"\x00"
@@ -554,7 +943,14 @@ class PartitionedTable:
                     if hit is None:
                         chunks = self._candidates_for(levels)
                         hit = (enc.cache_put(key, chunks), chunks)
+                        self._nenc_entries += 1
                         put[key] = hit
+                        # registrations are only consumed by the selective
+                        # cache_del branch; without it they'd accumulate in
+                        # _cand_keys_of forever (the per-version wholesale
+                        # clear never pops them)
+                        if enc.has_cache_del:
+                            self._register_cand(levels, ("n", key))
                     group[j], chunks = hit
                     counts[j] = len(chunks)
                     cand[j, : min(len(chunks), nc_cap)] = chunks[:nc_cap]
@@ -810,6 +1206,81 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     )
 
 
+def pack_chunk_tiles(t: PartitionedTable, cids: Sequence[int], dt) -> np.ndarray:
+    """Pack ONLY the given chunks into device tiles ``[K, L+3, CHUNK]`` —
+    the delta-upload payload (same field-major layout as
+    ``pack_device_rows``, so tiles scatter straight into the resident
+    array by leading-axis index)."""
+    lvl = t.max_levels
+    k = len(cids)
+    cid_arr = np.asarray(cids, dtype=np.int64)
+    rows = (cid_arr[:, None] * CHUNK + np.arange(CHUNK, dtype=np.int64)).reshape(-1)
+    packed = np.zeros((k * CHUNK, lvl + 3), dtype=dt)
+    packed[:, :lvl] = t.tok[rows].astype(dt)
+    packed[:, lvl] = t.flen[rows]
+    packed[:, lvl + 1] = t.prefix_len[rows]
+    packed[:, lvl + 2] = t.has_hash[rows].astype(dt) | (
+        t.first_wild[rows].astype(dt) << 1
+    )
+    return np.ascontiguousarray(
+        packed.reshape(k, CHUNK, lvl + 3).transpose(0, 2, 1)
+    )
+
+
+def delta_chunk_plan(t: PartitionedTable, *, enabled: bool, dev_version: int,
+                     has_resident: bool, dev_epoch: int, dev_lvl: int,
+                     dev_dtype, dt, dev_up_chunks: int):
+    """The delta-refresh validity gate, shared by every chunk-tile mirror
+    (local + mesh-replicated): → dirty chunk ids (possibly empty) when a
+    scatter refresh is sound, else None (caller full-uploads). The gate is
+    correctness-critical — a condition added here must hold for all
+    consumers, which is why it lives in one place."""
+    if (
+        not enabled
+        or dev_version < 0
+        or not has_resident
+        or dev_epoch != t.layout_epoch
+        or dev_lvl != t.max_levels
+        or dev_dtype != dt
+        or t.nchunks > dev_up_chunks
+    ):
+        return None
+    cids = t.delta.since(dev_version)
+    if cids is None or len(cids) > max(64, t.nchunks // 2):
+        return None  # journal too old / delta no cheaper than a repack
+    return cids
+
+
+def _pad_scatter_pow2(idx: np.ndarray, vals: np.ndarray):
+    """Pad a scatter's (indices, updates) to a pow2 count by repeating the
+    last entry: every distinct count would otherwise compile its own XLA
+    scatter, turning steady churn into a recompile per refresh. Duplicate
+    indices are safe — the repeated updates are identical."""
+    k = len(idx)
+    kp = 1 << (k - 1).bit_length() if k > 1 else 1
+    if kp == k:
+        return idx, vals
+    pad = kp - k
+    return (
+        np.concatenate([idx, np.repeat(idx[-1:], pad)]),
+        np.concatenate([vals, np.repeat(vals[-1:], pad, axis=0)]),
+    )
+
+
+class _Snap:
+    """What a match handle was submitted against: the device snapshot's
+    (version, layout epoch) plus the row→fid map array AS OF that version.
+    Completes decode through this — never through the live table — so a
+    mutation or compaction landing mid-flight can't tear a result."""
+
+    __slots__ = ("version", "epoch", "fid_map")
+
+    def __init__(self, version: int, epoch: int, fid_map: np.ndarray) -> None:
+        self.version = version
+        self.epoch = epoch
+        self.fid_map = fid_map
+
+
 class PartitionedMatcher:
     """Device mirror + batched match over a ``PartitionedTable``.
 
@@ -822,8 +1293,6 @@ class PartitionedMatcher:
 
     def __init__(self, table: PartitionedTable, device=None, max_words: int = 32,
                  compact: Optional[str] = None) -> None:
-        import os
-
         self.table = table
         self.device = device
         self.max_words = max_words
@@ -850,12 +1319,26 @@ class PartitionedMatcher:
         self._seg_bytes = int(os.environ.get("RMQTT_SEG_BYTES", str(256 << 20)))
         self._segments: Optional[List[Tuple[int, int, object]]] = None
         self._seg_nc: Dict[int, int] = {}  # sticky per-segment NC cap
+        self._seg_cap = 0  # chunks per segment at the last full build
+        # --- incremental (delta) device refresh: mutations scatter-write
+        # only their dirty chunks into the resident array(s) instead of
+        # re-packing + re-uploading the whole table (RMQTT_DELTA_UPLOADS=0
+        # restores the full-refresh behavior)
+        self.delta_enabled = os.environ.get("RMQTT_DELTA_UPLOADS", "1") != "0"
+        self.uploads = 0  # refresh events that shipped bytes (full + delta)
+        self.full_uploads = 0
+        self.delta_uploads = 0
+        self.upload_bytes = 0
+        # versioned device snapshot: what the resident arrays/fid map
+        # correspond to. In-flight handles carry these so completes decode
+        # against the snapshot they encoded with (double buffering)
+        self._dev_epoch = -1
+        self._dev_lvl = -1
+        self._dev_dtype: Optional[type] = None
+        self._dev_up_chunks = 0
+        self._dev_fid_map: Optional[np.ndarray] = None
 
     def _decide_pallas(self, dev, ttok, tlen, tdollar, chunk_ids) -> bool:
-        import logging
-        import os
-        import time
-
         env = os.environ.get("RMQTT_PALLAS", "")
         if env == "0":
             return False
@@ -868,7 +1351,7 @@ class PartitionedMatcher:
             # (~40s over the tunnel AOT helper) and a fresh matcher per
             # table (the bench builds one per config) must not re-pay it
             return _PALLAS_RACED
-        log = logging.getLogger("rmqtt_tpu.ops")
+        log = _LOG
         try:
             from rmqtt_tpu.ops.pallas_match import match_words_pallas
 
@@ -918,8 +1401,6 @@ class PartitionedMatcher:
             return False
 
     def _words(self, dev, ttok, tlen, tdollar, chunk_ids):
-        import os
-
         from rmqtt_tpu.ops.pallas_match import BT
 
         if chunk_ids.shape[0] % BT:
@@ -944,9 +1425,7 @@ class PartitionedMatcher:
                 # any decide-path surprise (e.g. a wedged backend raising
                 # from dev.devices()) degrades to lax, never crashes the
                 # match path
-                import logging
-
-                logging.getLogger("rmqtt_tpu.ops").warning(
+                _LOG.warning(
                     "pallas decide path failed (%s); using lax path", e)
                 self._pallas = False
         if self._pallas:
@@ -960,35 +1439,110 @@ class PartitionedMatcher:
 
     def _refresh(self):
         t = self.table
-        if self._dev_version != t.version or (
-            self._dev_arrays is None and self._segments is None
+        if self._dev_version == t.version and (
+            self._dev_arrays is not None or self._segments is not None
         ):
-            put = (
-                functools.partial(jax.device_put, device=self.device)
-                if self.device
-                else jax.device_put
-            )
+            return self._dev_arrays
+        with t._mu:
+            if self._dev_version == t.version and (
+                self._dev_arrays is not None or self._segments is not None
+            ):
+                return self._dev_arrays
+            dt = np.int32 if t._tok_wide else np.int16
+            if self._try_delta_refresh(t, dt):
+                return self._dev_arrays
+            # full path: repack + re-upload everything (first refresh,
+            # layout change, dtype widening, growth past the resident
+            # padding, or a delta journal that no longer reaches back far
+            # enough). Only the host-side PACK runs under the lock — the
+            # device transfer below must not stall subscribes for a
+            # multi-GB upload (the stall this PR removes); mutations that
+            # land during the transfer stay pending because the version
+            # installed is the one captured here.
             packed = pack_device_rows(t)
-            if packed.nbytes > self._seg_bytes and self.compact_mode == "global":
-                self._dev_arrays = None
-                self._segments = self._build_segments(packed, put)
-            else:
-                if packed.nbytes > self._seg_bytes:
-                    # only the 'global' wire format supports segment merge;
-                    # a topk-mode table crossing the budget at runtime must
-                    # keep working (single array, round-2 behavior), not
-                    # start raising on every publish
-                    import logging
-
-                    logging.getLogger("rmqtt_tpu.ops").warning(
-                        "table %dMB exceeds RMQTT_SEG_BYTES but compact_mode"
-                        "=%r cannot segment; keeping one device array",
-                        packed.nbytes >> 20, self.compact_mode,
-                    )
-                self._segments = None
-                self._dev_arrays = put(packed)
-            self._dev_version = t.version
+            version, epoch, lvl = t.version, t.layout_epoch, t.max_levels
+            fid_map = t._fid_of_row
+        put = (
+            functools.partial(jax.device_put, device=self.device)
+            if self.device
+            else jax.device_put
+        )
+        if packed.nbytes > self._seg_bytes and self.compact_mode == "global":
+            self._dev_arrays = None
+            self._segments = self._build_segments(packed, put)
+        else:
+            if packed.nbytes > self._seg_bytes:
+                # only the 'global' wire format supports segment merge;
+                # a topk-mode table crossing the budget at runtime must
+                # keep working (single array, round-2 behavior), not
+                # start raising on every publish
+                _LOG.warning(
+                    "table %dMB exceeds RMQTT_SEG_BYTES but compact_mode"
+                    "=%r cannot segment; keeping one device array",
+                    packed.nbytes >> 20, self.compact_mode,
+                )
+            self._segments = None
+            self._dev_arrays = put(packed)
+        self._dev_version = version
+        self._dev_epoch = epoch
+        self._dev_lvl = lvl
+        self._dev_dtype = dt
+        self._dev_up_chunks = (
+            packed.shape[0] if self._segments is None
+            else self._seg_cap * len(self._segments)
+        )
+        self._dev_fid_map = fid_map
+        self.uploads += 1
+        self.full_uploads += 1
+        self.upload_bytes += packed.nbytes
         return self._dev_arrays
+
+    def _try_delta_refresh(self, t: PartitionedTable, dt) -> bool:
+        """Scatter-write only the dirty chunks into the resident device
+        array(s). Possible iff the layout epoch, row width, tile dtype and
+        padded capacity all still match the resident snapshot; otherwise
+        (or when the delta journal overflowed) the caller full-uploads."""
+        cids = delta_chunk_plan(
+            t, enabled=self.delta_enabled, dev_version=self._dev_version,
+            has_resident=self._dev_arrays is not None or self._segments is not None,
+            dev_epoch=self._dev_epoch, dev_lvl=self._dev_lvl,
+            dev_dtype=self._dev_dtype, dt=dt, dev_up_chunks=self._dev_up_chunks,
+        )
+        if cids is None:
+            return False
+        if cids:
+            tiles = pack_chunk_tiles(t, cids, dt)
+            if self._segments is None:
+                idx, vals = _pad_scatter_pow2(
+                    np.asarray(cids, dtype=np.int32), tiles
+                )
+                self._dev_arrays = self._dev_arrays.at[idx].set(vals)
+            else:
+                self._apply_segment_delta(t, cids, tiles)
+            self.uploads += 1
+            self.delta_uploads += 1
+            self.upload_bytes += tiles.nbytes
+        self._dev_version = t.version
+        self._dev_fid_map = t._fid_of_row
+        return True
+
+    def _apply_segment_delta(self, t: PartitionedTable, cids, tiles) -> None:
+        """Scatter dirty chunks into their segment arrays (global chunk
+        ``cid`` lives at local index ``cid - base + 1`` for segments > 0;
+        see ``_build_segments``) and advance each segment's live end as the
+        table grows into the built-in padding."""
+        cid_arr = np.asarray(cids, dtype=np.int64)
+        segs = []
+        for si, (base, _end, dev) in enumerate(self._segments):
+            sel = (cid_arr >= base) & (cid_arr < base + self._seg_cap)
+            loc = cid_arr[sel] if si == 0 else cid_arr[sel] - (base - 1)
+            if len(loc):
+                idx, vals = _pad_scatter_pow2(
+                    loc.astype(np.int32), tiles[np.nonzero(sel)[0]]
+                )
+                dev = dev.at[idx].set(vals)
+            segs.append((base, min(base + self._seg_cap, t.nchunks), dev))
+        self._segments = segs
 
     def _build_segments(self, packed: np.ndarray, put):
         """Split the packed table into ≤``_seg_bytes`` device arrays.
@@ -1006,6 +1560,7 @@ class PartitionedMatcher:
         # tables (tests force segmentation at toy scale via _seg_bytes)
         align = 4096 if seg_chunks >= 4096 else (64 if seg_chunks >= 64 else 8)
         seg_chunks = (seg_chunks + align - 1) // align * align
+        self._seg_cap = seg_chunks
         segs: List[Tuple[int, int, object]] = []
         for base in range(0, total, seg_chunks):
             part = packed[base : base + seg_chunks]
@@ -1024,6 +1579,17 @@ class PartitionedMatcher:
         caller can submit batch N+1 (host encode) while N computes on
         device, then ``match_complete`` each handle in order. This is how
         the bench pipelines over a high-latency dispatch path."""
+        t = self.table
+        if t.compact_async:
+            # churn-triggered background compaction: the rebuild runs on
+            # its own thread while this (and following) dispatches keep
+            # matching against the fragmented-but-correct old layout
+            t.maybe_compact_async()
+        elif t.needs_compact():
+            # compact_async=false restores the synchronous rebuild (the
+            # pre-delta debugging behavior) — without this the layout
+            # would fragment unboundedly
+            t.compact()
         b = len(topics)
         if pad_to_pow2:
             padded = 1 << (b - 1).bit_length() if b > 1 else b
@@ -1040,24 +1606,32 @@ class PartitionedMatcher:
         else:
             padded = b
         want_groups = self.compact_mode == "global"
-        enc = self.table.encode_topics(
-            topics, pad_batch_to=padded, with_groups=want_groups
-        )
+        while True:
+            enc, enc_epoch = t.encode_topics_versioned(
+                topics, pad_batch_to=padded, with_groups=want_groups
+            )
+            dev = self._refresh()
+            if self._dev_epoch == enc_epoch:
+                break
+            # a compaction installed between the encode and the device
+            # refresh: the chunk ids reference the OLD layout while the
+            # device now holds the new one — re-encode (rare, bounded by
+            # compaction frequency)
+        snap = _Snap(self._dev_version, self._dev_epoch, self._dev_fid_map)
         ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
-        dev = self._refresh()
         if self._segments is not None:
-            return self._submit_segmented(ttok, tlen, tdollar, chunk_ids, b)
+            return self._submit_segmented(ttok, tlen, tdollar, chunk_ids, b, snap)
         words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
         if self.compact_mode == "global":
             if words is not None:
                 g = self._budget_for(padded, _nc)
                 packed = _compact_global(words, budget=g)
                 return ("g", b, chunk_ids, words,
-                        (dev, ttok, tlen, tdollar, None), packed, g, 0)
+                        (dev, ttok, tlen, tdollar, None), packed, g, 0, snap)
             split = self._split_plan(chunk_ids, b)
             if split is not None:
                 return self._submit_split(
-                    dev, ttok, tlen, tdollar, chunk_ids, split, 0
+                    dev, ttok, tlen, tdollar, chunk_ids, split, 0, snap
                 )
             grouped = self._group_inputs(enc[5], chunk_ids)
             g = self._budget_for(padded, _nc)
@@ -1072,7 +1646,7 @@ class PartitionedMatcher:
             # the handle carries ITS OWN budget: a sticky widening by a later
             # handle must not mask this one's truncation
             return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
-                    packed, g, 0)
+                    packed, g, 0, snap)
         wi, wb, cn = (
             _compact_words(words, max_words=self.max_words)
             if words is not None
@@ -1082,7 +1656,7 @@ class PartitionedMatcher:
         )
         # same contract: the handle carries ITS OWN max_words
         return ("k", b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn,
-                self.max_words)
+                self.max_words, snap)
 
     # ------------------------------------------------- NC split-dispatch
     SPLIT_MIN_BATCH = 1024  # small batches are dispatch-bound, not compute
@@ -1143,7 +1717,7 @@ class PartitionedMatcher:
             self._budgets[(padded, nc)] = g
         return g
 
-    def _submit_segmented(self, ttok, tlen, tdollar, chunk_ids, b: int):
+    def _submit_segmented(self, ttok, tlen, tdollar, chunk_ids, b: int, snap):
         """One sub-handle per table segment: global candidate chunk ids are
         remapped to segment-local ids (front-packed, trimmed to a sticky
         per-segment NC), matched against the segment's device array, and
@@ -1175,14 +1749,14 @@ class PartitionedMatcher:
             split = self._split_plan(loc, b)
             if split is not None:
                 handles.append(self._submit_split(
-                    dev, ttok, tlen, tdollar, loc, split, fid_base
+                    dev, ttok, tlen, tdollar, loc, split, fid_base, snap
                 ))
                 continue
             padded = loc.shape[0]
             g = self._budget_for(padded, ncs)
             packed = _match_global(dev, ttok, tlen, tdollar, loc, budget=g)
             handles.append(("g", b, loc, None, (dev, ttok, tlen, tdollar, None),
-                            packed, g, fid_base))
+                            packed, g, fid_base, snap))
         return ("M", b, handles)
 
     _EMPTY_FIDS = np.empty(0, dtype=np.int64)
@@ -1205,7 +1779,7 @@ class PartitionedMatcher:
         return out
 
     def _submit_split(self, dev, ttok, tlen, tdollar, chunk_ids, split,
-                      fid_base: int = 0):
+                      fid_base: int = 0, snap=None):
         order, sizes, tiers = split
         b = len(order)
         parts: List[Tuple] = []
@@ -1234,13 +1808,11 @@ class PartitionedMatcher:
             meta.append((s, pb, tier))
             budgets.append(g)
         packed = _match_global_split(dev, tuple(parts), tuple(budgets))
-        return ("s", b, order, meta, parts, dev, packed, tuple(budgets), fid_base)
+        return ("s", b, order, meta, parts, dev, packed, tuple(budgets), fid_base,
+                snap)
 
     def _complete_split(self, handle) -> List[np.ndarray]:
-        _tag, b, order, meta, parts, dev, packed, budgets, fid_base = handle
-        fid_map = self.table._fid_of_row
-        if fid_base:
-            fid_map = fid_map[fid_base:]
+        _tag, b, order, meta, parts, dev, packed, budgets, fid_base, snap = handle
         while True:
             arr = fetch(packed, "match result fetch")
             segs: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -1264,15 +1836,69 @@ class PartitionedMatcher:
                 break
             budgets = tuple(regrow)
             packed = _match_global_split(dev, tuple(parts), budgets)
-        out: List[Optional[np.ndarray]] = [None] * b
-        pos = 0
-        for (s, pb, tier), part, (routes_seg, cn) in zip(meta, parts, segs):
-            n = int(cn.sum())
-            rows = _decode_routes(routes_seg[:n], cn, part[3], s, fid_map)
-            for orig, r in zip(order[pos : pos + s], rows):
-                out[orig] = r
-            pos += s
-        return out  # type: ignore[return-value]
+        # the decode snapshot is taken AFTER the blocking fetch (like every
+        # other complete path); _decode_revalidated closes the
+        # overlay→gather write window without stalling mutations
+        def decode(fid_map, overlay, strict):
+            out: List[Optional[np.ndarray]] = [None] * b
+            pos = 0
+            for (s, pb, tier), part, (routes_seg, cn) in zip(meta, parts, segs):
+                n = int(cn.sum())
+                rows = _decode_routes(routes_seg[:n], cn, part[3], s, fid_map,
+                                      overlay=overlay, strict=strict)
+                for orig, r in zip(order[pos : pos + s], rows):
+                    out[orig] = r
+                pos += s
+            return out
+
+        return self._decode_revalidated(snap, fid_base, decode)
+
+    def _decode_revalidated(self, snap, fid_base: int, decode):
+        """Close the overlay→gather window without serializing decode
+        against mutations: run ``decode(fid_map, overlay, strict)``
+        optimistically lock-free, then revalidate ``table.version`` under
+        the lock. Mutations write the fid map and bump version under that
+        same lock, so an unchanged version proves no in-place write could
+        have landed between the overlay snapshot and the gather and the
+        result stands; a changed version (a subscribe raced this decode —
+        rare) redoes the decode under the lock. Holding the lock
+        unconditionally instead would stall every subscribe/unsubscribe
+        for the full decode, native per-topic sort included
+        (~10ms/200K routes)."""
+        t = self.table
+        v0 = t.version
+        res = decode(*self._snap_decode_state(snap, fid_base))
+        with t._mu:
+            if t.version == v0:
+                return res
+            return decode(*self._snap_decode_state(snap, fid_base))
+
+    def _snap_decode_state(self, snap, fid_base: int = 0):
+        """→ (fid_map, overlay, strict) for decoding a handle.
+
+        ``fid_map`` is the row→fid array the handle was submitted against;
+        ``overlay`` patches rows mutated since back to their submit-time
+        fids (None = nothing to patch); ``strict=False`` means the undo
+        journal overflowed — decode best-effort against the live map and
+        drop rows that have since been cleared instead of asserting."""
+        if snap is None:
+            fid_map = self.table._fid_of_row
+            overlay, ok = None, True
+        else:
+            fid_map = snap.fid_map
+            overlay, ok = self.table.fid_overlay(snap.version, snap.epoch)
+            if not ok or not overlay:
+                # journal too old (ok=False): the snapshot array still only
+                # carries ITS epoch's in-place writes — decode against it
+                # best-effort, dropping rows cleared since (never the live
+                # map, which may belong to a newer layout entirely)
+                overlay = None
+        if fid_base:
+            fid_map = fid_map[fid_base:]
+            if overlay:
+                overlay = {r - fid_base: f for r, f in overlay.items()
+                           if r >= fid_base}
+        return fid_map, overlay, ok
 
     def match_complete(self, handle) -> List[np.ndarray]:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
@@ -1282,7 +1908,7 @@ class PartitionedMatcher:
             return self._complete_split(handle)
         if handle[0] == "g":
             return self._complete_global(handle)
-        _tag, b, chunk_ids, words, dev_inputs, wi, wb, cn, kw = handle
+        _tag, b, chunk_ids, words, dev_inputs, wi, wb, cn, kw, snap = handle
         while True:
             wi, wb, cn = fetch(wi), fetch(wb), fetch(cn)
             if int(cn[:b].max(initial=0)) <= kw:
@@ -1297,7 +1923,11 @@ class PartitionedMatcher:
                 wi, wb, cn = _match_partitioned(
                     dev, ttok, tlen, tdollar, chunk_ids, max_words=kw
                 )
-        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
+        return self._decode_revalidated(
+            snap, 0,
+            lambda fid_map, overlay, strict: _decode_batch(
+                wi[:b], wb[:b], chunk_ids[:b], b, fid_map,
+                overlay=overlay, strict=strict))
 
     def _group_inputs(self, groups: np.ndarray, chunk_ids: np.ndarray):
         """→ (uniq_cand [U_pow2, NC], inv [B]) for the grouped upload, or
@@ -1321,7 +1951,7 @@ class PartitionedMatcher:
         return uniq_cand, inv.astype(inv_dt, copy=False)
 
     def _complete_global(self, handle) -> List[np.ndarray]:
-        _tag, b, chunk_ids, words, dev_inputs, packed, g, fid_base = handle
+        _tag, b, chunk_ids, words, dev_inputs, packed, g, fid_base, snap = handle
         padded, nc = chunk_ids.shape
         while True:
             # ONE fetch per match: [routes..., cnts...] (counts are
@@ -1347,10 +1977,11 @@ class PartitionedMatcher:
                     packed = _match_global_grouped(
                         dev, ttok, tlen, tdollar, *grouped, budget=g
                     )
-        fid_map = self.table._fid_of_row
-        if fid_base:
-            fid_map = fid_map[fid_base:]
-        return _decode_routes(arr[:n], cn, chunk_ids, b, fid_map)
+        return self._decode_revalidated(
+            snap, fid_base,
+            lambda fid_map, overlay, strict: _decode_routes(
+                arr[:n], cn, chunk_ids, b, fid_map,
+                overlay=overlay, strict=strict))
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         return self.match_complete(self.match_submit(topics, pad_to_pow2))
@@ -1364,20 +1995,42 @@ def _front_pack(a: np.ndarray) -> np.ndarray:
     return np.take_along_axis(a, order, axis=1)
 
 
+def _overlay_fids(rows, fids, tj, overlay, strict):
+    """Patch gathered fids through a submit-time overlay (rows mutated
+    after the handle's snapshot get their AS-OF fids back) and, in
+    non-strict mode, drop rows cleared since (their -1 is a legitimate
+    concurrent unsubscribe, not a device bug)."""
+    if overlay:
+        ov_rows = np.fromiter(overlay.keys(), dtype=np.int64, count=len(overlay))
+        m = np.isin(rows, ov_rows)
+        if m.any():
+            fids[m] = np.asarray(
+                [overlay[int(r)] for r in rows[m]], dtype=np.int64
+            )
+    if not strict:
+        keep = fids >= 0
+        if not bool(keep.all()):
+            return tj[keep], fids[keep]
+    return tj, fids
+
+
 def _decode_batch(
     wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int,
-    fid_map: np.ndarray,
+    fid_map: np.ndarray, overlay=None, strict: bool = True,
 ) -> List[np.ndarray]:
     """(word_idx, word_bits) → per-topic sorted FILTER-ID arrays.
 
     Prefers the native decoder (runtime/encode.cc rt_match_decode: bit
     extraction + fid map + per-topic sort in C++); the numpy fallback below
     doubles as its differential oracle (tests pin agreement). Decode is the
-    projected co-located host bottleneck, hence the attention."""
-    native = _native_decode(wi, wb, chunk_ids, b, fid_map)
-    if native is not None:
-        return native
-    return _numpy_decode(wi, wb, chunk_ids, b, fid_map)
+    projected co-located host bottleneck, hence the attention. A handle
+    with concurrent-mutation state (overlay / non-strict) takes the numpy
+    path — the rare case where correctness work is needed per row."""
+    if overlay is None and strict:
+        native = _native_decode(wi, wb, chunk_ids, b, fid_map)
+        if native is not None:
+            return native
+    return _numpy_decode(wi, wb, chunk_ids, b, fid_map, overlay, strict)
 
 
 def _native_decode(wi, wb, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
@@ -1400,7 +2053,7 @@ def _native_decode(wi, wb, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
 
 def _decode_routes(
     routes: np.ndarray, cn: np.ndarray, chunk_ids: np.ndarray, b: int,
-    fid_map: np.ndarray,
+    fid_map: np.ndarray, overlay=None, strict: bool = True,
 ) -> List[np.ndarray]:
     """Route-level global compaction → per-topic sorted fid arrays.
 
@@ -1411,10 +2064,11 @@ def _decode_routes(
     fid map + per-topic sort); the numpy fallback doubles as its
     differential oracle, where the composite-key sort in
     ``_group_sorted`` dominates (~10ms/200K routes)."""
-    native = _native_decode_routes(routes, cn, chunk_ids, b, fid_map)
-    if native is not None:
-        return native
-    return _numpy_decode_routes(routes, cn, chunk_ids, b, fid_map)
+    if overlay is None and strict:
+        native = _native_decode_routes(routes, cn, chunk_ids, b, fid_map)
+        if native is not None:
+            return native
+    return _numpy_decode_routes(routes, cn, chunk_ids, b, fid_map, overlay, strict)
 
 
 def _native_decode_routes(routes, cn, chunk_ids, b, fid_map) -> Optional[List[np.ndarray]]:
@@ -1436,7 +2090,7 @@ def _native_decode_routes(routes, cn, chunk_ids, b, fid_map) -> Optional[List[np
 
 def _numpy_decode_routes(
     routes: np.ndarray, cn: np.ndarray, chunk_ids: np.ndarray, b: int,
-    fid_map: np.ndarray,
+    fid_map: np.ndarray, overlay=None, strict: bool = True,
 ) -> List[np.ndarray]:
     wpc = WORDS_PER_CHUNK
     padded = chunk_ids.shape[0]
@@ -1454,6 +2108,7 @@ def _numpy_decode_routes(
         + (r & 31)
     )
     fids = fid_map[rows]
+    tj, fids = _overlay_fids(rows, fids, tj, overlay, strict)
     return _group_sorted(tj, fids, b)
 
 
@@ -1477,7 +2132,7 @@ def _group_sorted(tj: np.ndarray, fids: np.ndarray, b: int) -> List[np.ndarray]:
 
 def _numpy_decode(
     wi: np.ndarray, wb: np.ndarray, chunk_ids: np.ndarray, b: int,
-    fid_map: np.ndarray,
+    fid_map: np.ndarray, overlay=None, strict: bool = True,
 ) -> List[np.ndarray]:
     """Pure-numpy decode (fallback + differential oracle)."""
     wpc = WORDS_PER_CHUNK
@@ -1496,5 +2151,6 @@ def _numpy_decode(
         + cols
     )
     fids = fid_map[rows]
+    tj, fids = _overlay_fids(rows, fids, tj, overlay, strict)
     # one composite-key sort beats a two-key lexsort (~2x on 200K matches)
     return _group_sorted(tj, fids, b)

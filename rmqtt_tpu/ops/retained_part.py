@@ -105,7 +105,8 @@ class RetainedTable(PartitionedTable):
     def fid_snapshot(self) -> np.ndarray:
         """Immutable row→fid mapping AS OF NOW, for pipelined scan handles.
 
-        remove()/compact() mutate ``_fid_of_row`` in place, so a scan
+        remove() mutates ``_fid_of_row`` in place and compact() swaps in a
+        wholesale-new array (bumping ``version`` either way), so a scan
         completing after a mutation would otherwise decode bit positions
         against the post-mutation mapping (wrong/ghost fids). Memoized on
         ``version``: steady-state scans share one copy (O(1) per scan);
@@ -117,14 +118,20 @@ class RetainedTable(PartitionedTable):
             snap = self._fid_snap = (self.version, self._fid_of_row.copy())
         return snap[1]
 
+    def _write_row(self, row: int, levels) -> None:
+        # the base writer derives first_wild from wildcards (always False
+        # here); re-derive the $-flag it carries instead, so a compaction
+        # replay (install-time journal re-add) preserves it
+        super()._write_row(row, levels)
+        self.first_wild[row] = bool(levels[0]) and is_metadata(levels[0])
+
     def add(self, topic: str | Sequence[str]) -> int:
         levels = split_levels(topic) if isinstance(topic, str) else list(topic)
         if any(lev in (PLUS, HASH) for lev in levels):
             raise ValueError(f"retained topic may not contain wildcards: {topic!r}")
+        # the $-topic marker in the first_wild flag slot is set by the
+        # _write_row override above (single source, shared with replay)
         fid = super().add(levels)
-        row = self._row_of_fid[fid]
-        # $-topic marker rides in the first_wild flag slot (see class doc)
-        self.first_wild[row] = bool(levels[0]) and is_metadata(levels[0])
         key = self._key_of_fid[fid]
         if key not in self._indexed:
             self._indexed.add(key)
@@ -315,7 +322,7 @@ class PartitionedRetainedScanner:
     def _refresh(self):
         t = self.table
         if self._dev_version != t.version or self._dev_rows is None:
-            if t.dirty_ops > max(1024, t.size // 5):
+            if t.needs_compact():  # honors compact_min_ops/compact_ratio
                 t.compact()
             # sync the narrow-dtype flags BEFORE packing: pack_device_rows
             # reads _tok_wide directly, and the flag only flips inside
@@ -410,9 +417,10 @@ class PartitionedRetainedScanner:
         out = _retained_scan_combo(dev, tuple(gather_parts), tuple(full_parts),
                                    slab=slab)
         # snapshot the row→fid mapping (memoized per table version):
-        # remove()/compact() mutate _fid_of_row in place, so a pipelined
-        # scan completing after a mutation would decode bit positions
-        # against the post-mutation mapping and return wrong/ghost fids
+        # remove() mutates _fid_of_row in place and compact() swaps the
+        # array, so a pipelined scan completing after a mutation would
+        # decode bit positions against the post-mutation mapping and
+        # return wrong/ghost fids
         return ("h", out, metas, order, len(filters), t.fid_snapshot())
 
     def scan_complete(self, handle) -> List[np.ndarray]:

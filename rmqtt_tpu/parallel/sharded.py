@@ -148,6 +148,20 @@ class ShardedPartitionedMatcher:
         self._gsteps = {}  # per-device budget -> jitted shard_map step
         self._dev_version = -1
         self._dev_rows = None
+        # replicated delta puts: mutations scatter only their dirty chunks
+        # into the replicated table (mirrors PartitionedMatcher._refresh);
+        # the scatter runs as one jnp op so the update replicates over ICI
+        # instead of re-shipping the whole table from the host
+        self.delta_enabled = os.environ.get("RMQTT_DELTA_UPLOADS", "1") != "0"
+        self._dev_epoch = -1
+        self._dev_lvl = -1
+        self._dev_dtype = None
+        self._dev_up_chunks = 0
+        self._dev_fid_map = None
+        self.uploads = 0
+        self.full_uploads = 0
+        self.delta_uploads = 0
+        self.upload_bytes = 0
 
     def _global_step(self, budget_per_dev: int):
         step = self._gsteps.get(budget_per_dev)
@@ -176,27 +190,85 @@ class ShardedPartitionedMatcher:
         return step
 
     def _refresh(self):
-        from rmqtt_tpu.ops.partitioned import pack_device_rows
+        from rmqtt_tpu.ops.partitioned import (
+            _pad_scatter_pow2,
+            delta_chunk_plan,
+            pack_chunk_tiles,
+            pack_device_rows,
+        )
 
         t = self.table
-        if self._dev_version != t.version or self._dev_rows is None:
-            self._dev_rows = jax.device_put(
-                pack_device_rows(t), NamedSharding(self.mesh, P())  # replicated
+        if self._dev_version == t.version and self._dev_rows is not None:
+            return self._dev_rows
+        with t._mu:
+            if self._dev_version == t.version and self._dev_rows is not None:
+                return self._dev_rows
+            dt = np.int16 if not t._tok_wide else np.int32
+            cids = delta_chunk_plan(
+                t, enabled=self.delta_enabled, dev_version=self._dev_version,
+                has_resident=self._dev_rows is not None,
+                dev_epoch=self._dev_epoch, dev_lvl=self._dev_lvl,
+                dev_dtype=self._dev_dtype, dt=dt,
+                dev_up_chunks=self._dev_up_chunks,
             )
-            self._dev_version = t.version
+            if cids is not None:
+                if cids:
+                    tiles = pack_chunk_tiles(t, cids, dt)
+                    idx, vals = _pad_scatter_pow2(
+                        np.asarray(cids, dtype=np.int32), tiles
+                    )
+                    self._dev_rows = self._dev_rows.at[idx].set(vals)
+                    self.uploads += 1
+                    self.delta_uploads += 1
+                    self.upload_bytes += tiles.nbytes
+                self._dev_version = t.version
+                self._dev_fid_map = t._fid_of_row
+                return self._dev_rows
+            # full path: pack + capture under the lock, TRANSFER outside it
+            # (same as PartitionedMatcher._refresh — the replicated multi-GB
+            # put must not stall subscribes); mutations landing during the
+            # transfer stay pending via the captured version
+            packed = pack_device_rows(t)
+            version, epoch, lvl = t.version, t.layout_epoch, t.max_levels
+            fid_map = t._fid_of_row
+        self._dev_rows = jax.device_put(
+            packed, NamedSharding(self.mesh, P())  # replicated
+        )
+        self._dev_version = version
+        self._dev_epoch = epoch
+        self._dev_lvl = lvl
+        self._dev_dtype = dt
+        self._dev_up_chunks = packed.shape[0]
+        self._dev_fid_map = fid_map
+        self.uploads += 1
+        self.full_uploads += 1
+        self.upload_bytes += packed.nbytes
         return self._dev_rows
 
     def match(self, topics) -> list:
         from rmqtt_tpu.ops.partitioned import _decode_batch, _match_partitioned
 
+        t = self.table
+        if getattr(t, "compact_async", False):
+            # same churn trigger as PartitionedMatcher.match_submit (the
+            # inline encode-time compact is gone on this path too)
+            t.maybe_compact_async()
+        elif hasattr(t, "needs_compact") and t.needs_compact():
+            t.compact()
         b = len(topics)
         padded = max(self.ndev, 1 << (b - 1).bit_length() if b > 1 else 1)
         if padded % self.ndev:
             padded = self.ndev * ((padded + self.ndev - 1) // self.ndev)
-        ttok, tlen, tdollar, chunk_ids, _nc = self.table.encode_topics(
-            topics, pad_batch_to=padded
-        )
-        dev = self._refresh()
+        while True:
+            enc, enc_epoch = self.table.encode_topics_versioned(
+                topics, pad_batch_to=padded
+            )
+            ttok, tlen, tdollar, chunk_ids, _nc = enc
+            dev = self._refresh()
+            if self._dev_epoch == enc_epoch:
+                break
+            # a background compaction installed between encode and refresh:
+            # chunk ids reference the old layout — re-encode (rare)
         batch_spec = NamedSharding(self.mesh, P(("dp", "fp")))
         row_spec = NamedSharding(self.mesh, P(("dp", "fp"), None))
         inputs = (
@@ -215,7 +287,32 @@ class ShardedPartitionedMatcher:
             # rare overflow: re-run only the kernel, wider (inputs stay on
             # device; no re-encode/re-upload)
             self.max_words = 1 << (int(cn[:b].max()) - 1).bit_length()
-        return _decode_batch(wi[:b], wb[:b], chunk_ids[:b], b, self.table._fid_of_row)
+        return self._decode_revalidated(
+            lambda fid_map, overlay, strict: _decode_batch(
+                wi[:b], wb[:b], chunk_ids[:b], b, fid_map,
+                overlay=overlay, strict=strict))
+
+    def _decode_state(self):
+        """Same snapshot decode as PartitionedMatcher._snap_decode_state:
+        the refresh-time fid map plus the undo overlay for mutations that
+        landed during the device round trip."""
+        t = self.table
+        fid_map = self._dev_fid_map if self._dev_fid_map is not None else t._fid_of_row
+        overlay, ok = t.fid_overlay(self._dev_version, self._dev_epoch)
+        return fid_map, (overlay or None) if ok else None, ok
+
+    def _decode_revalidated(self, decode):
+        """Same optimistic decode as PartitionedMatcher._decode_revalidated:
+        decode lock-free, then revalidate table.version under the lock —
+        unchanged proves the overlay→gather window saw no in-place fid-map
+        write; changed (rare raced mutation) redoes under the lock."""
+        t = self.table
+        v0 = t.version
+        res = decode(*self._decode_state())
+        with t._mu:
+            if t.version == v0:
+                return res
+            return decode(*self._decode_state())
 
     def _match_global(self, dev, inputs, chunk_ids, b: int, padded: int) -> list:
         from rmqtt_tpu.ops.partitioned import _decode_routes
@@ -240,7 +337,8 @@ class ShardedPartitionedMatcher:
         # concatenate each shard's valid prefix; shard-major == topic-major,
         # so the concatenated counts reattribute slots globally
         parts = [per_dev[i, : int(totals[i])] for i in range(self.ndev)]
-        return _decode_routes(
-            np.concatenate(parts), cn.ravel(), chunk_ids, b,
-            self.table._fid_of_row,
-        )
+        return self._decode_revalidated(
+            lambda fid_map, overlay, strict: _decode_routes(
+                np.concatenate(parts), cn.ravel(), chunk_ids, b, fid_map,
+                overlay=overlay, strict=strict,
+            ))

@@ -15,6 +15,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import TpuMatcher
 from rmqtt_tpu.router.base import (
@@ -42,8 +44,8 @@ class _TreeSide:
         self._tree.remove(topic_filter, fid)
 
     def match(self, topic: str):
-        import numpy as np
-
+        # numpy is imported at module scope: this sits on the small-batch
+        # dispatch path and must not pay a per-call import lookup
         vals = [v for _lv, vs in self._tree.matches(topic) for v in vs]
         return np.asarray(vals, dtype=np.int64)
 
@@ -246,6 +248,21 @@ class XlaRouter(Router):
                     time.perf_counter_ns() - t0,
                     {"backend": "xla", "batch": len(items)})
         return self._expand(items, rows)
+
+    def device_stats(self) -> Dict[str, float]:
+        """Device-table lifecycle counters for RoutingService.stats():
+        upload/compaction activity of the HBM mirror (delta vs full, bytes
+        shipped, background compactions and their cost, selective
+        candidate-cache invalidations)."""
+        m, t = self.matcher, self.table
+        return {
+            "uploads": getattr(m, "uploads", 0),
+            "delta_uploads": getattr(m, "delta_uploads", 0),
+            "upload_bytes": getattr(m, "upload_bytes", 0),
+            "compactions": getattr(t, "compactions", 0),
+            "compact_ms": round(getattr(t, "compact_ms", 0.0), 3),
+            "cand_cache_invalidations": getattr(t, "cand_cache_invalidations", 0),
+        }
 
     def is_match(self, topic: str) -> bool:
         if self._side is not None:

@@ -84,6 +84,11 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
     ]
     lib.rt_enc_cache_put.restype = ctypes.c_int32
+    if hasattr(lib, "rt_enc_cache_del"):  # absent in pre-delta .so builds
+        lib.rt_enc_cache_del.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.rt_enc_cache_del.restype = ctypes.c_int32
     lib.rt_enc_encode.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
@@ -231,6 +236,8 @@ class NativeEncoder:
         self._ptr = ctypes.c_void_p(lib.rt_enc_new())
         self.tokens_synced = 0  # count of TokenDict entries pushed so far
         self.cache_version = -1  # table.version the candidate cache reflects
+        self.cache_epoch = -1  # table.layout_epoch the cache was built under
+        self.has_cache_del = hasattr(lib, "rt_enc_cache_del")
 
     def __del__(self) -> None:
         ptr = getattr(self, "_ptr", None)
@@ -244,6 +251,15 @@ class NativeEncoder:
 
     def cache_clear(self) -> None:
         self._lib.rt_enc_cache_clear(self._ptr)
+
+    def cache_del(self, key: bytes) -> int:
+        """Erase one prefix entry (selective invalidation); returns the
+        number of entries dropped. A stale prebuilt .so without the symbol
+        degrades to a full clear — correct, just colder."""
+        if not self.has_cache_del:
+            self.cache_clear()
+            return 1
+        return self._lib.rt_enc_cache_del(self._ptr, key, len(key))
 
     def cache_put(self, key: bytes, chunks: np.ndarray) -> int:
         """→ the gid the native side assigned to this entry (authoritative —

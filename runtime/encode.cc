@@ -99,6 +99,17 @@ void rt_enc_cache_clear(void* h) {
   enc->next_gid = 0;
 }
 
+// Erase one cached prefix entry. Selective invalidation: a subscription
+// mutation drops only the prefixes whose candidate sets it could change
+// (partitioned.py _invalidate_cand); survivors keep their gids, which is
+// why gids are monotonic and never reissued outside rt_enc_cache_clear.
+int32_t rt_enc_cache_del(void* h, const char* key, int32_t keylen) {
+  auto* enc = static_cast<Encoder*>(h);
+  return enc->cand_cache.erase(std::string(key, static_cast<size_t>(keylen)))
+             ? 1
+             : 0;
+}
+
 int32_t rt_enc_cache_put(void* h, const char* key, int32_t keylen,
                          const int32_t* chunks, int32_t n) {
   auto* enc = static_cast<Encoder*>(h);

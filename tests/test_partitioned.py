@@ -208,7 +208,7 @@ def test_native_encode_matches_python_path():
     # force the pure-python path on the same table
     table._nenc = False
     table._cand_cache.clear()
-    table._cand_version = -1
+    table._cand_keys_of.clear()
     py = table.encode_topics(topics, pad_batch_to=256)
     names = ["ttok", "tlen", "tdollar", "chunk_ids"]
     for a, b, name in zip(native[:4], py[:4], names):

@@ -1,0 +1,442 @@
+"""Churn-resilient device table: delta uploads, double-buffered refresh,
+background compaction (ops/partitioned.py tentpole).
+
+The core property: a ``PartitionedMatcher`` whose device mirror advances by
+DELTA scatter-writes through arbitrary interleavings of add/remove/compact/
+match must produce results identical to brute-force semantics at every
+step, in both single-array and segmented device modes. Plus the pinned
+contracts: ``encode_topics`` never compacts inline, background compaction
+swaps atomically, the candidate cache invalidates selectively, and
+in-flight handles decode against the snapshot they were submitted with.
+"""
+
+import asyncio
+import random
+import time
+
+import numpy as np
+import pytest
+
+from rmqtt_tpu.core.topic import filter_valid, match_filter
+from rmqtt_tpu.ops.partitioned import (
+    PartitionedMatcher,
+    PartitionedTable,
+    pack_device_rows,
+)
+
+WORDS = ["a", "b", "c", "d", "", "+"]
+TOPIC_WORDS = ["a", "b", "c", "d", "e", "", "$s"]
+
+
+def _random_filter(rng):
+    depth = rng.randint(1, 6)
+    levels = [rng.choice(WORDS) for _ in range(depth)]
+    if rng.random() < 0.3:
+        levels[-1] = "#"
+    return "/".join(levels)
+
+
+def _random_topics(rng, n):
+    return [
+        "/".join(rng.choice(TOPIC_WORDS) for _ in range(rng.randint(1, 7)))
+        for _ in range(n)
+    ]
+
+
+def _seed_table(rng, n):
+    table = PartitionedTable()
+    fids = {}
+    while len(fids) < n:
+        f = _random_filter(rng)
+        if filter_valid(f):
+            fids[table.add(f)] = f
+    return table, fids
+
+
+def _check(matcher, fids, topics, ctx=""):
+    got = matcher.match(topics)
+    for topic, row in zip(topics, got):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert sorted(row.tolist()) == expect, f"{ctx}: {topic}"
+
+
+def _interleaved(seed, segmented):
+    rng = random.Random(seed)
+    table, fids = _seed_table(rng, 500)
+    matcher = PartitionedMatcher(table)
+    if segmented:
+        matcher._seg_bytes = 1 << 15  # force several segments at toy scale
+    ops = 0
+    for step in range(60):
+        r = rng.random()
+        if r < 0.35 and fids:
+            for fid in rng.sample(sorted(fids), min(len(fids), rng.randint(1, 25))):
+                table.remove(fid)
+                del fids[fid]
+                ops += 1
+        elif r < 0.75:
+            for _ in range(rng.randint(1, 25)):
+                f = _random_filter(rng)
+                if filter_valid(f):
+                    fids[table.add(f)] = f
+                    ops += 1
+        elif r < 0.85:
+            table.compact()
+        else:
+            _check(matcher, fids, _random_topics(rng, rng.randint(1, 24)),
+                   ctx=f"step {step}")
+    _check(matcher, fids, _random_topics(rng, 32), ctx="final")
+    assert ops > 100
+    # the point of the exercise: the mirror advanced by deltas, not repacks
+    assert matcher.delta_uploads > 0, "delta path never exercised"
+
+
+def test_delta_interleaved_vs_oracle():
+    _interleaved(101, segmented=False)
+
+
+def test_delta_interleaved_vs_oracle_segmented():
+    _interleaved(202, segmented=True)
+
+
+def test_encode_topics_never_compacts_inline():
+    """Pinned: no stop-the-world compact on the dispatch path. Even at an
+    absurd dirty-op count, encode_topics must not call compact()."""
+    rng = random.Random(7)
+    table, _fids = _seed_table(rng, 200)
+    table.dirty_ops = 10_000_000
+
+    def boom():  # pragma: no cover - the assertion is that it never runs
+        raise AssertionError("encode_topics called compact() inline")
+
+    table.compact = boom
+    table._compact = boom
+    table.encode_topics(["a/b/c", "x/y"], pad_batch_to=4)
+    assert table.needs_compact()  # the trigger condition held the whole time
+
+
+def test_background_compaction_swaps_atomically():
+    rng = random.Random(17)
+    table, fids = _seed_table(rng, 400)
+    matcher = PartitionedMatcher(table)
+    topics = _random_topics(rng, 16)
+    _check(matcher, fids, topics, ctx="pre")
+    # churn past the trigger threshold
+    table.compact_min_ops = 8
+    table.compact_ratio = 1_000_000
+    for fid in rng.sample(sorted(fids), 30):
+        table.remove(fid)
+        del fids[fid]
+    assert table.needs_compact()
+    epoch0 = table.layout_epoch
+    # the dispatch path kicks the background rebuild off
+    h = matcher.match_submit(topics)
+    rows = matcher.match_complete(h)
+    th = table._compact_thread
+    assert th is not None, "match_submit did not trigger background compaction"
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert table.layout_epoch == epoch0 + 1
+    assert table.compactions == 1
+    assert table.dirty_ops <= 1  # journal replays only build-window ops
+    for topic, row in zip(topics, rows):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert sorted(row.tolist()) == expect, topic
+    _check(matcher, fids, topics, ctx="post-install")  # fresh layout serves
+    # cand cache and device handle invalidated together with the swap.
+    # Checked QUIESCENTLY: if the install above landed mid-match_submit,
+    # the epoch-check re-encode legitimately repopulates the cache AFTER
+    # the swap cleared it (encode and install both hold _mu, so entries
+    # are always for the layout they were built under — never stale).
+    table.encode_topics(topics)
+    table.compact()
+    assert table.compactions == 2
+    assert not table._cand_cache and not table._cand_keys_of
+
+
+def test_background_compaction_with_concurrent_mutations():
+    """Mutations landing while the build runs are journaled and replayed:
+    nothing lost, nothing duplicated."""
+    rng = random.Random(23)
+    table, fids = _seed_table(rng, 600)
+    matcher = PartitionedMatcher(table)
+    # hold the build open manually: run _compact on a thread while this
+    # thread mutates, synchronized by monkeypatching the builder
+    import rmqtt_tpu.ops.partitioned as P
+    import threading
+
+    built = threading.Event()
+    release = threading.Event()
+    real_build = P._build_compact_state
+
+    def slow_build(*a, **kw):
+        built.set()
+        assert release.wait(timeout=30)
+        return real_build(*a, **kw)
+
+    P._build_compact_state = slow_build
+    try:
+        t = threading.Thread(target=table._compact, daemon=True)
+        t.start()
+        assert built.wait(timeout=30)
+        # mutations during the build window
+        removed = rng.sample(sorted(fids), 40)
+        for fid in removed:
+            table.remove(fid)
+            del fids[fid]
+        added = []
+        for _ in range(40):
+            f = _random_filter(rng)
+            if filter_valid(f):
+                fid = table.add(f)
+                fids[fid] = f
+                added.append(fid)
+        release.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        P._build_compact_state = real_build
+        release.set()
+    assert table.compactions == 1
+    assert table.size == len(fids)
+    _check(matcher, fids, _random_topics(rng, 48), ctx="post-replay")
+
+
+def test_sync_compact_fallback_when_async_disabled():
+    """compact_async=false restores synchronous compaction on the dispatch
+    path (not 'no compaction ever' — the layout must not fragment
+    unboundedly)."""
+    rng = random.Random(3)
+    table, fids = _seed_table(rng, 300)
+    table.compact_async = False
+    table.compact_min_ops = 8
+    table.compact_ratio = 1_000_000
+    matcher = PartitionedMatcher(table)
+    topics = _random_topics(rng, 8)
+    matcher.match(topics)
+    c0 = table.compactions
+    for fid in rng.sample(sorted(fids), 20):
+        table.remove(fid)
+        del fids[fid]
+    assert table.needs_compact()
+    rows = matcher.match_complete(matcher.match_submit(topics))
+    assert table.compactions == c0 + 1 and not table.needs_compact()
+    for topic, row in zip(topics, rows):
+        expect = sorted(fid for fid, f in fids.items() if match_filter(f, topic))
+        assert sorted(row.tolist()) == expect, topic
+
+
+def test_selective_cand_cache_invalidation():
+    """A version bump no longer clears the whole candidate cache: entries
+    whose partition keys the mutation never touched survive."""
+    table = PartitionedTable()
+    table._nenc = False  # pin the python cache (the native one is C++-side)
+    for i in range(40):
+        table.add(f"alpha/x/{i}")
+        table.add(f"beta/y/{i}")
+    table.encode_topics(["alpha/x/1", "beta/y/1"], pad_batch_to=2)
+    keys = set(table._cand_cache)
+    assert len(keys) == 2
+    before = table.cand_cache_invalidations
+    # partition ("4","alpha","x","+") is consulted by topic alpha/x/1 but
+    # never by beta/y/1 — only the alpha entry may drop
+    table.add("alpha/x/+")
+    after_keys = set(table._cand_cache)
+    surviving = [k for k in after_keys if k[1] == "beta"]
+    dropped = [k for k in keys if k[1] == "alpha" and k in after_keys]
+    assert surviving, "untouched partition's entry was invalidated"
+    assert not dropped, "touched partition's entry survived"
+    assert table.cand_cache_invalidations > before
+    # and the surviving entry still serves correct candidates
+    m = PartitionedMatcher(table)
+    (row,) = m.match(["beta/y/1"])
+    assert len(row) == 1
+    (row,) = m.match(["alpha/x/1"])
+    assert len(row) == 2  # the exact filter + the new alpha/x/+
+
+
+def test_selective_invalidation_matches_oracle_under_reuse():
+    """Cache-on vs cache-cleared parity across a mutation mix (gid reuse /
+    stale-entry hazards would surface as wrong candidates here)."""
+    rng = random.Random(31)
+    table, fids = _seed_table(rng, 400)
+    table._nenc = False
+    matcher = PartitionedMatcher(table)
+    topics = _random_topics(rng, 64)
+    for round_ in range(6):
+        _check(matcher, fids, topics, ctx=f"warm round {round_}")
+        for fid in rng.sample(sorted(fids), 20):
+            table.remove(fid)
+            del fids[fid]
+        for _ in range(20):
+            f = _random_filter(rng)
+            if filter_valid(f):
+                fids[table.add(f)] = f
+        # entries for untouched prefixes stay warm across the mutations
+    assert table.cand_cache_invalidations > 0
+
+
+def test_cand_cache_cap_clear_parity():
+    """The candidate-cache size cap clears wholesale BETWEEN batches; match
+    results must stay correct across clears on both encoder paths (a
+    mid-batch native clear would reset gids and alias grouped uploads)."""
+    rng = random.Random(41)
+    table, fids = _seed_table(rng, 300)
+    table.cand_cache_max = 4  # force a wholesale clear on nearly every batch
+    matcher = PartitionedMatcher(table)
+    for r in range(4):
+        _check(matcher, fids, _random_topics(rng, 48), ctx=f"native round {r}")
+    table2, fids2 = _seed_table(rng, 300)
+    table2.cand_cache_max = 4
+    table2._nenc = False  # python path
+    matcher2 = PartitionedMatcher(table2)
+    for r in range(4):
+        _check(matcher2, fids2, _random_topics(rng, 48), ctx=f"py round {r}")
+
+
+def test_inflight_handle_survives_mutation():
+    """Double buffering: a handle submitted before a mutation completes
+    against the table snapshot it encoded with (no crash, no cross-wired
+    fids when a freed row is re-used mid-flight)."""
+    table = PartitionedTable()
+    fids = {table.add(f"s/{i}/t"): f"s/{i}/t" for i in range(64)}
+    fids[table.add("s/+/t")] = "s/+/t"
+    matcher = PartitionedMatcher(table)
+    matcher.match(["s/1/t"])  # warm the device mirror
+    h = matcher.match_submit(["s/1/t", "s/2/t"])
+    # mid-flight: remove a matched filter and let its row be re-used
+    victim = next(fid for fid, f in fids.items() if f == "s/1/t")
+    submit_fids = dict(fids)
+    table.remove(victim)
+    del fids[victim]
+    fids[table.add("zzz/q")] = "zzz/q"  # likely reuses the freed slot
+    rows = matcher.match_complete(h)
+    for topic, row in zip(["s/1/t", "s/2/t"], rows):
+        expect = sorted(
+            fid for fid, f in submit_fids.items() if match_filter(f, topic)
+        )
+        assert sorted(row.tolist()) == expect, topic
+
+
+def test_inflight_handle_survives_compact():
+    table = PartitionedTable()
+    fids = {table.add(f"s/{i}/t"): f"s/{i}/t" for i in range(300)}
+    matcher = PartitionedMatcher(table)
+    matcher.match(["s/5/t"])
+    h = matcher.match_submit(["s/5/t"])
+    table.compact()  # wholesale layout change while the handle is in flight
+    (row,) = matcher.match_complete(h)
+    expect = sorted(fid for fid, f in fids.items() if match_filter(f, "s/5/t"))
+    assert sorted(row.tolist()) == expect
+
+
+def test_dense_filter_table_delta():
+    """Same dirty-tracking on the dense FilterTable/TpuMatcher path."""
+    from rmqtt_tpu.ops.encode import FilterTable
+    from rmqtt_tpu.ops.match import TpuMatcher
+
+    rng = random.Random(5)
+    table = FilterTable(capacity=1024)
+    fids = {}
+    for _ in range(300):
+        f = _random_filter(rng)
+        if filter_valid(f):
+            fids[table.add(f)] = f
+    m = TpuMatcher(table, chunk=1024)
+    topics = _random_topics(rng, 24)
+
+    def check(ctx):
+        got = m.match(topics)
+        for topic, row in zip(topics, got):
+            expect = sorted(
+                fid for fid, f in fids.items() if match_filter(f, topic)
+            )
+            assert sorted(row.tolist()) == expect, f"{ctx}: {topic}"
+
+    check("initial")
+    for round_ in range(4):
+        for fid in rng.sample(sorted(fids), 30):
+            table.remove(fid)
+            del fids[fid]
+        for _ in range(30):
+            f = _random_filter(rng)
+            if filter_valid(f):
+                fids[table.add(f)] = f
+        check(f"round {round_}")
+    assert m.delta_uploads > 0
+    assert m.full_uploads >= 1
+
+
+def test_churn_smoke_delta_bytes_bounded():
+    """Fast CPU churn loop (tier-1): per-mutation upload traffic through
+    the pipelined submit/complete path is a small fraction of a full-table
+    repack — the delta path is exercised on every run."""
+    rng = random.Random(77)
+    table, fids = _seed_table(rng, 800)
+    matcher = PartitionedMatcher(table)
+    topics = _random_topics(rng, 32)
+    matcher.match(topics)  # initial full upload
+    full_bytes = pack_device_rows(table).nbytes
+    base_bytes = matcher.upload_bytes
+    mutations = 0
+    pending = None
+    for _ in range(30):
+        # one add + one remove per batch, pipelined like the broker
+        f = _random_filter(rng)
+        if filter_valid(f):
+            fids[table.add(f)] = f
+            mutations += 1
+        fid = rng.choice(sorted(fids))
+        table.remove(fid)
+        del fids[fid]
+        mutations += 1
+        h = matcher.match_submit(topics)
+        if pending is not None:
+            matcher.match_complete(pending)
+        pending = h
+    matcher.match_complete(pending)
+    assert matcher.delta_uploads > 0
+    per_mutation = (matcher.upload_bytes - base_bytes) / mutations
+    assert per_mutation * 10 <= full_bytes, (
+        f"delta upload {per_mutation:.0f}B/mutation not ≥10x below the "
+        f"{full_bytes}B full repack"
+    )
+    _check(matcher, fids, topics, ctx="final")
+
+
+def test_routing_stop_drains_odd_completion_items():
+    """stop() must reject parked waiters regardless of the completion-queue
+    item shape (defensive item[0] destructure, broker/routing.py)."""
+    from rmqtt_tpu.broker.routing import RoutingService
+    from rmqtt_tpu.router.default import DefaultRouter
+
+    async def go():
+        svc = RoutingService(DefaultRouter())
+        svc.start()
+        fut = asyncio.get_running_loop().create_future()
+        batch = [(None, "t", fut, False, 0, None)]
+        # a 7-tuple item (future queue-shape change) must not TypeError
+        await svc._completion_q.put((batch, None, None, 0, 1, "extra", "extra2"))
+        await svc.stop()
+        assert fut.done() and isinstance(fut.exception(), RuntimeError)
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_device_stats_surface():
+    """XlaRouter.device_stats → RoutingService.stats keys (Prometheus /
+    dashboard / $SYS ride on these being present and numeric)."""
+    from rmqtt_tpu.broker.routing import RoutingService
+    from rmqtt_tpu.router.base import Id, SubscriptionOptions
+    from rmqtt_tpu.router.xla import XlaRouter
+
+    router = XlaRouter(mesh=None)
+    router.add("a/b", Id(1, "c1"), SubscriptionOptions(qos=0))
+    svc = RoutingService(router)
+    router.matcher.match(["a/b"])
+    stats = svc.stats()
+    for key in ("routing_uploads", "routing_delta_uploads",
+                "routing_upload_bytes", "routing_compactions",
+                "routing_compact_ms_total", "routing_cand_cache_invalidations"):
+        assert key in stats and isinstance(stats[key], (int, float)), key
+    assert stats["routing_uploads"] >= 1
