@@ -1219,6 +1219,204 @@ def run_churn_config(name, rng, reduced):
     return res
 
 
+def run_failover_config(name, rng, reduced):
+    """Config 10: device-plane failover soak (broker/failover.py).
+
+    Steady QoS1 publish load through a broker whose routing is pinned to
+    the DEVICE plane; at t=2s the ``device.dispatch`` failpoint kills the
+    kernel path (every batch errors), at t=4s it recovers. The failover
+    plane must serve the outage from the host trie with zero lost
+    publishes, then probe, force a full HBM re-upload and switch back.
+    Emits the goodput dip, per-phase delivered p99 (steady vs failover vs
+    post-recovery) and time-to-switchback — the regression gate for
+    recovery time in future PRs."""
+    import asyncio
+    import struct
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.server import MqttBroker
+    from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+    # rate the CPU-jax device path sustains headroom-free (each batch pays
+    # a jax dispatch; on a real chip this is conservative) — oversubscribing
+    # here would measure deliver-queue overflow, not failover behavior
+    pub_rate = 60 if reduced else 90  # msgs/s
+    soak_s = 4.5 if reduced else 6.0
+    fault_at, clear_at = (1.5, 3.0) if reduced else (2.0, 4.0)
+    pad = b"f" * 56
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec(pk.V311)
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError("no CONNACK")
+            if codec.feed(data):
+                return reader, writer, codec
+
+    async def soak():
+        # cache off: every publish must reach the dispatcher, or cache hits
+        # would mask the device outage entirely
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, router="xla", route_cache=False,
+            failover_cooldown=0.3, failover_threshold=2,
+            failover_k_successes=2)))
+        r = b.ctx.router
+        r._hybrid_max = 0  # pin every batch to the device plane
+        r._hybrid.small_max = 0
+        r._hybrid.probe_every = 0
+        await b.start()
+        sw = pw = None
+        try:
+            fo = b.ctx.routing.failover
+            assert fo is not None and fo.usable
+            sr, sw, sc = await _connect(b.port, "c10-sub")
+            sw.write(sc.encode(pk.Subscribe(1, [("fo10/#", pk.SubOpts(qos=0))])))
+            await sw.drain()
+            pr, pw, pcodec = await _connect(b.port, "c10-pub")
+            # per-phase latency + arrival counts, bucketed by SEND time
+            lat = {"steady": [], "failover": [], "recovered": []}
+            received = [0]
+            stop = asyncio.Event()
+            t0 = None
+
+            def phase_of(sent_rel):
+                if sent_rel < fault_at:
+                    return "steady"
+                if sent_rel < clear_at:
+                    return "failover"
+                return "recovered"
+
+            async def sub_loop():
+                while not stop.is_set():
+                    try:
+                        data = await asyncio.wait_for(sr.read(65536), 0.25)
+                    except asyncio.TimeoutError:
+                        continue
+                    if not data:
+                        return
+                    now = time.perf_counter()
+                    for p in sc.feed(data):
+                        # warm-up publishes ride a different topic: excluded
+                        # from the measured counts and latencies
+                        if isinstance(p, pk.Publish) and p.topic == "fo10/t":
+                            ts = struct.unpack("d", p.payload[:8])[0]
+                            lat[phase_of(ts - t0)].append(now - ts)
+                            received[0] += 1
+
+            # JIT warm OUTSIDE the measured window: the measured bursts run at
+            # batch≈5 (pow2-padded to 8), so warm that shape too or the first
+            # measured batch pays the compile and poisons the steady p99
+            for _ in range(3):
+                for _ in range(5):
+                    pw.write(pcodec.encode(pk.Publish(
+                        topic="fo10/warm",
+                        payload=struct.pack("d", time.perf_counter()) + pad)))
+                await pw.drain()
+                await asyncio.sleep(0.3)
+            await asyncio.sleep(1.0)
+            task = asyncio.get_running_loop().create_task(sub_loop())
+            sent = 0
+            goodput = []  # per-0.5s received buckets
+            switchback_s = None
+            fault_set = cleared = False
+            burst = 5
+            t0 = time.perf_counter()
+            last_bucket, last_rx = t0, 0
+            while True:
+                el = time.perf_counter() - t0
+                # capture BEFORE the exit checks: a switchback landing after
+                # soak_s (breaker backoff pushed the probe late) would otherwise
+                # break out of the loop un-recorded
+                if cleared and switchback_s is None and not fo.active:
+                    switchback_s = time.perf_counter() - t0 - clear_at
+                if el >= soak_s and not fo.active:
+                    break
+                if el >= soak_s + 20:
+                    break  # no switchback: report it instead of hanging
+                if not fault_set and el >= fault_at:
+                    FAILPOINTS.set("device.dispatch", "error")
+                    fault_set = True
+                if not cleared and el >= clear_at:
+                    FAILPOINTS.set("device.dispatch", "off")
+                    cleared = True
+                if el < soak_s:
+                    for _ in range(burst):
+                        payload = struct.pack("d", time.perf_counter()) + pad
+                        pw.write(pcodec.encode(pk.Publish(topic="fo10/t", payload=payload)))
+                    sent += burst
+                    await pw.drain()
+                now = time.perf_counter()
+                if now - last_bucket >= 0.5:
+                    goodput.append((received[0] - last_rx) / (now - last_bucket))
+                    last_bucket, last_rx = now, received[0]
+                await asyncio.sleep(burst / pub_rate)
+            await asyncio.sleep(0.5)  # grace: in-flight deliveries land
+            stop.set()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+            def p99(xs):
+                return round(float(np.percentile(xs, 99)) * 1e3, 2) if xs else None
+
+            steady_gp = [g for g in goodput[: max(1, int(fault_at / 0.5))] if g > 0]
+            fault_gp = goodput[int(fault_at / 0.5): int(clear_at / 0.5)]
+            res = {
+                "sent": sent,
+                "received": received[0],
+                "lost": sent - received[0],
+                "steady_p99_ms": p99(lat["steady"]),
+                "failover_p99_ms": p99(lat["failover"]),
+                "recovered_p99_ms": p99(lat["recovered"]),
+                "steady_goodput_msgs_per_sec": round(
+                    sum(steady_gp) / max(1, len(steady_gp)), 1),
+                "failover_min_goodput_msgs_per_sec": round(min(fault_gp), 1)
+                if fault_gp else None,
+                "time_to_switchback_s": round(switchback_s, 2)
+                if switchback_s is not None else None,
+                "failovers": fo.failovers,
+                "switchbacks": fo.switchbacks,
+                "host_routed": fo.host_items,
+                "device_failures": dict(fo.failures),
+                "full_uploads": getattr(b.ctx.router.matcher, "full_uploads", 0),
+            }
+            if res["steady_goodput_msgs_per_sec"] and res["failover_min_goodput_msgs_per_sec"]:
+                res["goodput_dip_pct"] = round(
+                    100.0 * (1 - res["failover_min_goodput_msgs_per_sec"]
+                             / res["steady_goodput_msgs_per_sec"]), 1)
+            return res
+        finally:
+            # a mid-soak failure must not leak the armed process-
+            # global failpoint or the running broker (same
+            # discipline as tests/test_stress_chaos.py)
+            FAILPOINTS.clear_all()
+            for w in (sw, pw):
+                try:
+                    if w is not None:
+                        w.close()
+                except Exception:
+                    pass
+            await b.stop()
+
+    res = {"name": name, "pub_rate": pub_rate, "soak_s": soak_s,
+           "fault_window_s": [fault_at, clear_at],
+           **asyncio.run(soak()),
+           **({"reduced_sizes": True} if reduced else {})}
+    log(f"[{name}] sent {res['sent']} received {res['received']} "
+        f"(lost {res['lost']}) | p99 steady {res['steady_p99_ms']}ms "
+        f"failover {res['failover_p99_ms']}ms recovered {res['recovered_p99_ms']}ms | "
+        f"switchback in {res['time_to_switchback_s']}s "
+        f"(failovers {res['failovers']}, host-routed {res['host_routed']})")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -1231,7 +1429,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-9")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-10")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -1282,12 +1480,12 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 9
+            return i <= 10
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak) and cfg9 (churn soak / delta uploads) are cheap,
         # host-side and always informative
-        return i <= 3 or i in (6, 7, 8, 9) or args.full or on_tpu
+        return i <= 3 or i in (6, 7, 8, 9, 10) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -1396,6 +1594,12 @@ def main():
 
         guarded("cfg9_churn_soak", cfg9)
 
+    if want(10):
+        def cfg10():
+            return run_failover_config("cfg10_failover_soak", rng, reduced)
+
+        guarded("cfg10_failover_soak", cfg10)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -1403,6 +1607,32 @@ def main():
     tele_res = results.pop("cfg7_telemetry_overhead", None)
     overload_res = results.pop("cfg8_overload_soak", None)
     churn_res = results.pop("cfg9_churn_soak", None)
+    failover_res = results.pop("cfg10_failover_soak", None)
+    if (not results and failover_res is not None and churn_res is None
+            and overload_res is None and tele_res is None and cache_res is None):
+        sb = failover_res["time_to_switchback_s"]
+        no_sb = sb is None
+        if no_sb:
+            # the soak gives up soak_s+20s in (see run_failover_config);
+            # emit that observation bound instead of null so numeric
+            # consumers (regression gates, plots) see a finite worst case
+            # in exactly the failure this metric exists to catch
+            sb = round(failover_res["soak_s"] + 20.0
+                       - failover_res["fault_window_s"][1], 2)
+        print(json.dumps({
+            "metric": "failover_switchback_s[cfg10_failover_soak]",
+            "value": sb,
+            "unit": "seconds_to_switchback",
+            "vs_baseline": sb,
+            **({"no_switchback": True} if no_sb else {}),
+            "lost": failover_res["lost"],
+            "failover_p99_ms": failover_res["failover_p99_ms"],
+            "steady_p99_ms": failover_res["steady_p99_ms"],
+            "platform": platform,
+            "failover_soak": failover_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        return
     if (not results and churn_res is not None and overload_res is None
             and tele_res is None and cache_res is None):
         print(json.dumps({
@@ -1415,6 +1645,7 @@ def main():
             "median_pair_ratio": churn_res["median_pair_ratio"],
             "platform": platform,
             "churn_soak": churn_res,
+            **({"failover_soak": failover_res} if failover_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1427,6 +1658,7 @@ def main():
             "platform": platform,
             "overload_soak": overload_res,
             **({"churn_soak": churn_res} if churn_res else {}),
+            **({"failover_soak": failover_res} if failover_res else {}),
             **({"failed_configs": failures} if failures else {}),
         }))
         return
@@ -1531,6 +1763,9 @@ def main():
         # churn soak (cfg9): delta-upload traffic + p99-under-churn evidence
         # for the churn-resilient device table (ops/partitioned.py)
         **({"churn_soak": churn_res} if churn_res is not None else {}),
+        # failover soak (cfg10): goodput dip + time-to-switchback evidence
+        # for the device-plane failover (broker/failover.py)
+        **({"failover_soak": failover_res} if failover_res is not None else {}),
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }

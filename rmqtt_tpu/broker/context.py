@@ -148,6 +148,21 @@ class BrokerConfig:
     overload_breaker_threshold: int = 5
     overload_breaker_cooldown: float = 3.0
     overload_breaker_max_cooldown: float = 30.0
+    # device-plane failover (broker/failover.py, [routing] failover_* keys):
+    # classified device-router failures trip a breaker; while open, publishes
+    # route through the host trie mirror, half-open probes rewarm (full HBM
+    # re-upload) + canary-match before switching back. Only engages on
+    # routers exposing a host fallback (XlaRouter's hybrid side table).
+    failover_enable: bool = True
+    failover_timeout_s: float = 30.0  # per-batch device deadline (watchdog)
+    failover_threshold: int = 3  # consecutive failures before opening
+    failover_cooldown: float = 1.0  # first probe delay (exp backoff after)
+    failover_max_cooldown: float = 30.0
+    failover_k_successes: int = 3  # consecutive canary passes to switch back
+    # [failpoints] conf section (utils/failpoints.py): site name → action
+    # spec ("off | error | delay(ms) | hang | prob(p, act) | times(n, act)");
+    # RMQTT_FAILPOINTS env entries override these at context construction
+    failpoints: Dict[str, str] = field(default_factory=dict)
     fitter: FitterConfig = field(default_factory=FitterConfig)
 
 
@@ -275,6 +290,41 @@ class ServerContext:
         from rmqtt_tpu.broker.overload import OverloadController
 
         self.overload = OverloadController(self, self.cfg)
+        # failpoints ([failpoints] conf section, utils/failpoints.py):
+        # applied here so broker configs reach the process registry; the
+        # RMQTT_FAILPOINTS env string is re-applied on top (env outranks
+        # file, matching the load() precedence for every other section)
+        if self.cfg.failpoints:
+            import os as _os
+
+            from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+            FAILPOINTS.configure(self.cfg.failpoints)
+            _env = _os.environ.get("RMQTT_FAILPOINTS", "")
+            if _env:
+                FAILPOINTS.configure_env(_env)
+        # device-plane failover (broker/failover.py): wired only for routers
+        # with a host fallback (XlaRouter's trie mirror); the breaker lives
+        # in the overload registry so it surfaces in /api/v1/overload and
+        # the open-breaker gauges like every other wrapped egress
+        if self.cfg.failover_enable and callable(
+            getattr(router, "host_available", None)
+        ):
+            from rmqtt_tpu.broker.failover import DeviceFailover
+
+            self.routing.failover = DeviceFailover(
+                router,
+                self.overload.breaker(
+                    "routing.device",
+                    threshold=self.cfg.failover_threshold,
+                    cooldown=self.cfg.failover_cooldown,
+                    max_cooldown=self.cfg.failover_max_cooldown,
+                ),
+                timeout_s=self.cfg.failover_timeout_s,
+                k_successes=self.cfg.failover_k_successes,
+                metrics=self.metrics,
+                telemetry=self.telemetry,
+            )
 
     @property
     def handshaking(self) -> int:

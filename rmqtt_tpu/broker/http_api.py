@@ -235,6 +235,7 @@ class HttpApi:
                 "/api/v1/metrics", "/api/v1/metrics/sum",
                 "/api/v1/latency", "/api/v1/latency/sum",
                 "/api/v1/overload",
+                "/api/v1/failpoints", "/api/v1/routing/failover",
                 "/api/v1/traces", "/api/v1/traces/slow",
                 "/api/v1/traces/{trace_id}",
                 "/api/v1/plugins", "/api/v1/plugins/{plugin}",
@@ -407,6 +408,30 @@ class HttpApi:
             # state + signals, admission counters, shed totals, breakers;
             # shape-stable when the subsystem is disabled
             return 200, {"node": ctx.node_id, **ctx.overload.snapshot()}, J
+        if path == "/api/v1/failpoints":
+            # fault-injection registry (utils/failpoints.py). GET lists every
+            # site's action + trigger counters; PUT reconfigures sites live
+            # ({"site": "spec", ...} — "off" disarms) so chaos drills flip
+            # faults against a running broker without a restart.
+            from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+            if method == "PUT":
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    return 400, {"error": "body must be {site: spec, ...}"}, J
+                FAILPOINTS.configure({str(k): str(v) for k, v in req.items()})
+                log.warning("failpoints reconfigured via http: %s",
+                            {str(k): str(v) for k, v in req.items()})
+            return 200, {"node": ctx.node_id,
+                         "failpoints": FAILPOINTS.snapshot()}, J
+        if path == "/api/v1/routing/failover":
+            # device-plane failover state (broker/failover.py): breaker,
+            # host-routed counters, reason-labeled failures; a static
+            # "unavailable" shape for routers with no host fallback
+            fo = ctx.routing.failover
+            body_out = (fo.snapshot() if fo is not None
+                        else {"state": "unavailable", "state_value": 0})
+            return 200, {"node": ctx.node_id, **body_out}, J
         if path == "/api/v1/traces/slow":
             # slow traces cluster-wide (broker/tracing.py): per-node
             # summaries merged + deduped by trace id
@@ -572,6 +597,15 @@ class HttpApi:
             name = "rmqtt_" + sanitize(k) + "_total"
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name}{{{labels}}} {v}")
+        # failpoint trigger counters (utils/failpoints.py): one site-labeled
+        # family so chaos drills can assert exactly which seams fired
+        from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+        lines.append("# TYPE rmqtt_failpoint_triggers_total counter")
+        for site, snap in FAILPOINTS.snapshot().items():
+            lines.append(
+                f'rmqtt_failpoint_triggers_total{{{labels},'
+                f'site="{site}"}} {snap["triggers"]}')
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         # tracing counters + span-store gauge (broker/tracing.py)
@@ -611,7 +645,9 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_cache_invalidations","routing_cache_evictions",
  "routing_cache_door_rejects","routing_uploads","routing_delta_uploads",
  "routing_upload_bytes","routing_compactions","routing_compact_ms_total",
- "routing_cand_cache_invalidations"];
+ "routing_cand_cache_invalidations","routing_failover_state",
+ "routing_failovers","routing_switchbacks","routing_failover_host_routed",
+ "routing_device_failures"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
 // histogram units are ns, rendered as ms)
 const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],

@@ -96,6 +96,14 @@ class Stats:
         self.overload_state = 0
         self.overload_transitions = 0
         self.overload_open_breakers = 0
+        # device-plane failover gauges (broker/failover.py), overwritten
+        # from RoutingService.stats(); zeros for routers without a host
+        # fallback. state is 0=device (healthy) 1=host fallback 2=probing
+        self.routing_failover_state = 0
+        self.routing_failovers = 0
+        self.routing_switchbacks = 0
+        self.routing_failover_host_routed = 0
+        self.routing_device_failures = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

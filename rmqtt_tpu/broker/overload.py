@@ -208,6 +208,22 @@ class CircuitBreaker:
         }
 
 
+def backoff_delays(attempts: int, base: float = 0.05, cap: float = 1.0,
+                   factor: float = 2.0, jitter: float = 0.1,
+                   rng: Optional[random.Random] = None):
+    """The breaker's cooldown discipline as a reusable schedule: yields
+    ``attempts - 1`` sleep durations (the first try is immediate), each
+    ``min(cap, base * factor**i)`` plus up to ``jitter`` randomization so
+    a herd of retriers against one busy resource doesn't probe in
+    lockstep. Bounded by construction — exhausting the generator is the
+    caller's signal to give up and surface the error."""
+    r = rng if rng is not None else random
+    d = float(base)
+    for _ in range(max(0, int(attempts) - 1)):
+        yield min(float(cap), d) * (1.0 + float(jitter) * r.random())
+        d *= float(factor)
+
+
 # ------------------------------------------------------ watermark machine
 @dataclass
 class Watermark:

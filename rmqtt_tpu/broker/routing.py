@@ -32,6 +32,7 @@ import asyncio
 import time
 from typing import List, Optional, Tuple
 
+from rmqtt_tpu.broker.failover import _swallow_abandoned
 from rmqtt_tpu.broker.telemetry import NULL_TELEMETRY, Telemetry
 from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.router.base import Id, Router, SubRelationsMap
@@ -39,6 +40,10 @@ from rmqtt_tpu.router.cache import MatchCache
 
 
 class RoutingService:
+    #: consecutive per-item failures in _isolate before the rest of the
+    #: batch rejects without further retries (systemic-outage bailout)
+    _ISOLATE_FAIL_STREAK = 3
+
     def __init__(
         self,
         router: Router,
@@ -75,6 +80,10 @@ class RoutingService:
         self._pipe_sem: Optional[asyncio.Semaphore] = None  # built in start()
         self._completion_q: asyncio.Queue = asyncio.Queue()
         self._completer: Optional[asyncio.Task] = None
+        # device-plane failover (broker/failover.py), wired by ServerContext
+        # for device routers with a host trie mirror; None keeps every
+        # dispatch guard a single attribute test
+        self.failover = None
         # epoch-versioned match-result cache (pre-queue fast path). The
         # cache is only sound for routers that OPT IN via epochs_tracked
         # (their add/remove bump Router.epochs on every mutation); any
@@ -144,6 +153,19 @@ class RoutingService:
             # /stats/sum), NOT _ms (averaged like latency percentiles)
             "routing_compact_ms_total": d.get("compact_ms", 0.0),
             "routing_cand_cache_invalidations": d.get("cand_cache_invalidations", 0),
+            # device-plane failover gauges (broker/failover.py): zeros when
+            # failover is not wired so the surface stays shape-stable.
+            # state: 0 = device (healthy), 1 = host fallback, 2 = probing
+            "routing_failover_state": (
+                self.failover.state_value() if self.failover is not None else 0),
+            "routing_failovers": (
+                self.failover.failovers if self.failover is not None else 0),
+            "routing_switchbacks": (
+                self.failover.switchbacks if self.failover is not None else 0),
+            "routing_failover_host_routed": (
+                self.failover.host_items if self.failover is not None else 0),
+            "routing_device_failures": (
+                self.failover.failure_total if self.failover is not None else 0),
         }
 
     def queue_fraction(self) -> float:
@@ -160,6 +182,8 @@ class RoutingService:
             self._completer = loop.create_task(self._complete_loop())
 
     async def stop(self) -> None:
+        if self.failover is not None:
+            self.failover.stop()  # cancel probe/pacer background tasks
         for name in ("_task", "_completer"):
             t = getattr(self, name)
             if t is not None:
@@ -307,9 +331,16 @@ class RoutingService:
         return items, groups
 
     def _resolve(self, batch, results, groups=None) -> None:
+        """Resolve waiters from per-item results. A result slot may be an
+        EXCEPTION (the poisoned-batch isolation path, ``_isolate``): it
+        rejects only that item's waiters — the co-batched publishes still
+        resolve normally."""
         if groups is None:
             for (_, _, fut, raw, _t, _tr), res in zip(batch, results):
                 if fut.done():
+                    continue
+                if isinstance(res, BaseException):
+                    fut.set_exception(res)
                     continue
                 try:
                     fut.set_result(res if raw else self.router.collapse(res))
@@ -319,6 +350,12 @@ class RoutingService:
                     fut.set_exception(e)
             return
         for (idxs, snap), res in zip(groups, results):
+            if isinstance(res, BaseException):
+                for i in idxs:
+                    fut = batch[i][2]
+                    if not fut.done():
+                        fut.set_exception(res)
+                continue
             topic = batch[idxs[0]][1]
             entry = self.cache.put(topic, res, snap)
             # ONE waiter may consume the fresh raw directly (its containers
@@ -387,14 +424,26 @@ class RoutingService:
                     if tr is not None:  # same t0/t_disp reads as the stage
                         tr.add("routing.queue_wait", it[4], wait, it[1])
             tele.record("routing.batch_size", len(items))
+        fo = self.failover
+        if fo is not None and fo.active:
+            # device plane is out: route through the host trie mirror and
+            # (once the breaker cooldown elapses) kick a background probe
+            fo.maybe_probe(loop)
+            await self._host_dispatch(loop, batch, items, groups, t_disp)
+            return
         if inline_ok(len(items)):
+            # inline batches are HOST-served by contract (inline_ok is only
+            # true for the trie routers / the hybrid's trie branch): a
+            # failure here is host-side poison, not device evidence — it
+            # must neither trip nor reset the device breaker
             try:
-                self._resolve(batch, self.router.matches_batch_raw(items), groups)
+                results = self.router.matches_batch_raw(items)
             except Exception as e:
-                self._reject(batch, e)
-            finally:
-                if t_disp:
-                    self._record_match(t_disp, len(items), batch)
+                await self._isolate(loop, batch, items, groups, e)
+                return
+            self._resolve(batch, results, groups)
+            if t_disp:
+                self._record_match(t_disp, len(items), batch)
             return
         if pipelined:
             # in-flight bound: block BEFORE submitting so at most
@@ -402,43 +451,188 @@ class RoutingService:
             await self._pipe_sem.acquire()
             self.inflight += 1
             try:
-                done, payload = await loop.run_in_executor(
-                    None, self.router.submit_batch_raw, items
-                )
+                done, payload = await self._device_call(
+                    loop, self.router.submit_batch_raw, items,
+                    "device dispatch")
+            except TimeoutError as e:
+                self.inflight -= 1
+                self._pipe_sem.release()
+                await self._device_failed(
+                    loop, batch, items, groups, e, "timeout", t_disp)
+                return
             except Exception as e:
                 self.inflight -= 1
                 self._pipe_sem.release()
-                self._reject(batch, e)
+                await self._device_failed(
+                    loop, batch, items, groups, e, "dispatch_error", t_disp)
                 return
             except asyncio.CancelledError:
                 self.inflight -= 1
                 self._pipe_sem.release()
                 raise
             if done:
-                # the router resolved synchronously (e.g. the hybrid served
-                # it from the host trie): don't spend a pipeline permit or
-                # a completion-queue round trip on it
+                # the router resolved synchronously (the hybrid's trie
+                # branch, or a device matcher with no submit entry point):
+                # don't spend a pipeline permit or a completion-queue round
+                # trip on it
                 self.inflight -= 1
                 self._pipe_sem.release()
                 self._resolve(batch, payload, groups)
                 if t_disp:
                     self._record_match(t_disp, len(items), batch)
+                if fo is not None and self._served_by_device():
+                    fo.note_device_ok()
                 return
-            await self._completion_q.put((batch, groups, payload, t_disp, len(items)))
+            await self._completion_q.put((batch, groups, payload, t_disp, items))
             return
         self.inflight += 1
         try:
             results = await loop.run_in_executor(
                 None, self.router.matches_batch_raw, items
             )
-        except Exception as e:  # resolve all waiters with the error
-            self._reject(batch, e)
-            return
-        finally:
+        except Exception as e:
             self.inflight -= 1
+            await self._device_failed(
+                loop, batch, items, groups, e, "dispatch_error", t_disp)
+            return
+        except asyncio.CancelledError:
+            self.inflight -= 1
+            raise
+        self.inflight -= 1
         self._resolve(batch, results, groups)
         if t_disp:
             self._record_match(t_disp, len(items), batch)
+        if fo is not None and self._served_by_device():
+            fo.note_device_ok()
+
+    def _served_by_device(self) -> bool:
+        """Was the dispatch that just resolved served by the DEVICE matcher?
+        Hybrid routers report per-batch (last_match_was_device — reads are
+        safe: dispatches are serialized on the single batcher task); plain
+        device routers have no trie branch, so default True."""
+        probe = getattr(self.router, "last_match_was_device", None)
+        return probe() if callable(probe) else True
+
+    async def _device_call(self, loop, fn, arg, what: str):
+        """One device-router call in the executor, under the failover
+        plane's per-batch deadline. On timeout the executor thread is
+        ABANDONED (its eventual result/exception is swallowed) so a hung
+        kernel can never wedge the dispatch or completion loop — the
+        watchdog contract of broker/failover.py."""
+        fo = self.failover
+        fut = loop.run_in_executor(None, fn, arg)
+        if fo is None or not fo.usable or fo.timeout_s <= 0:
+            return await fut
+        done, pending = await asyncio.wait({fut}, timeout=fo.timeout_s)
+        if pending:
+            fut.add_done_callback(_swallow_abandoned)
+            raise TimeoutError(
+                f"{what} exceeded the {fo.timeout_s:.1f}s failover deadline")
+        return fut.result()
+
+    async def _host_dispatch(self, loop, batch, items, groups, t_disp) -> None:
+        """Serve one batch through the host trie mirror (failover plane):
+        same resolve semantics as the device path, plus ``routing.failover``
+        trace spans and the host-routed counters."""
+        fo = self.failover
+        router = self.router
+        t0 = time.perf_counter_ns() if (t_disp or self.tele.enabled) else 0
+        try:
+            if router.host_inline_ok():
+                results = router.host_matches_batch_raw(items)
+            else:
+                results = await loop.run_in_executor(
+                    None, router.host_matches_batch_raw, items)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # the host fallback itself failed (e.g. a genuinely poisoned
+            # topic): isolate per item ON THE HOST PATH so one bad encode
+            # doesn't reject the co-batched publishes
+            await self._isolate(loop, batch, items, groups, e,
+                                router.host_matches_batch_raw)
+            return
+        fo.note_host_batch(len(items))
+        self._resolve(batch, results, groups)
+        if t0:
+            dur = time.perf_counter_ns() - t0
+            detail = {"backend": "host-fallback", "batch": len(items)}
+            for it in batch:
+                tr = it[5]
+                if tr is not None:
+                    tr.add("routing.failover", t0, dur, detail)
+            self.tele.record("routing.match", dur, detail)
+
+    async def _device_failed(self, loop, batch, items, groups, exc,
+                             reason: str, t_disp) -> None:
+        """A batch failed on the primary path. With a usable failover plane
+        the failure is CLASSIFIED (breaker bookkeeping; the breaker opening
+        activates host routing) and this batch is served from the host trie
+        — zero lost publishes. Without one, fall back to poisoned-batch
+        isolation: split-and-retry so only the faulty item's futures reject."""
+        fo = self.failover
+        if fo is not None and fo.usable:
+            from rmqtt_tpu.broker.failover import classify
+
+            fo.record_failure(classify(exc, reason))
+            await self._host_dispatch(loop, batch, items, groups, t_disp)
+            return
+        await self._isolate(loop, batch, items, groups, exc)
+
+    async def _isolate(self, loop, batch, items, groups, exc,
+                       match_fn=None) -> None:
+        """Poisoned-batch isolation (one bad topic encode must not reject
+        its co-batched publishes): split the failed batch in half, retry
+        each half once, and for a half that still fails match its items
+        one by one — failures become per-item exceptions that ``_resolve``
+        routes to only their own waiters.
+
+        The per-item pass bails out after ``_ISOLATE_FAIL_STREAK``
+        consecutive failures: poison is item-shaped (a bad topic fails
+        alone among healthy neighbours), so an unbroken failure run means
+        the PATH is down (dead device with no usable failover plane) — and
+        then 2+N guaranteed-to-fail serial retries per batch would back up
+        the dispatch loop exactly when the broker is already degraded.
+        Remaining items reject with the original error immediately."""
+        if match_fn is None:
+            match_fn = getattr(self.router, "matches_batch_raw", None)
+        n = len(items)
+        if n == 1 or match_fn is None:
+            # a single item IS the poison; a pipelined-only router (no
+            # synchronous batch entry point) can't be retried — both
+            # degrade to rejecting with the original error
+            self._resolve(batch, [exc] * n, groups)
+            return
+        results: list = [exc] * n
+        streak = 0
+
+        async def retry(lo: int, hi: int) -> None:
+            nonlocal streak
+            try:
+                sub = await loop.run_in_executor(None, match_fn, items[lo:hi])
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                for j in range(lo, hi):  # per-item: isolate the poison
+                    if streak >= self._ISOLATE_FAIL_STREAK:
+                        return  # systemic, not poison: stop amplifying
+                    try:
+                        one = await loop.run_in_executor(
+                            None, match_fn, items[j:j + 1])
+                        results[j] = one[0]
+                        streak = 0
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        results[j] = e
+                        streak += 1
+            else:
+                results[lo:hi] = sub
+
+        mid = n // 2
+        await retry(0, mid)
+        await retry(mid, n)
+        self._resolve(batch, results, groups)
 
     def _record_match(self, t0: int, n: int, batch=None) -> None:
         """Per-dispatch backend match latency (submit → results expanded).
@@ -462,21 +656,33 @@ class RoutingService:
     async def _complete_loop(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            batch, groups, handle, t_disp, n = await self._completion_q.get()
+            batch, groups, handle, t_disp, items = await self._completion_q.get()
+            fo = self.failover
             try:
-                results = await loop.run_in_executor(
-                    None, self.router.complete_batch_raw, handle
-                )
-            except asyncio.CancelledError:
-                # shutdown mid-completion: don't strand these waiters
-                self._reject(batch, RuntimeError("routing service stopped"))
-                raise
-            except Exception as e:
-                self._reject(batch, e)
-            else:
-                self._resolve(batch, results, groups)
-                if t_disp:
-                    self._record_match(t_disp, n, batch)
+                try:
+                    # watchdog (broker/failover.py): a hung device completes
+                    # nothing — the deadline serves the batch from the host
+                    # trie and abandons the wedged executor thread instead
+                    # of wedging this loop with it
+                    results = await self._device_call(
+                        loop, self.router.complete_batch_raw, handle,
+                        "device completion")
+                except asyncio.CancelledError:
+                    # shutdown mid-completion: don't strand these waiters
+                    self._reject(batch, RuntimeError("routing service stopped"))
+                    raise
+                except TimeoutError as e:
+                    await self._device_failed(
+                        loop, batch, items, groups, e, "timeout", t_disp)
+                except Exception as e:
+                    await self._device_failed(
+                        loop, batch, items, groups, e, "complete_error", t_disp)
+                else:
+                    self._resolve(batch, results, groups)
+                    if t_disp:
+                        self._record_match(t_disp, len(items), batch)
+                    if fo is not None:
+                        fo.note_device_ok()
             finally:
                 self.inflight -= 1
                 self._pipe_sem.release()

@@ -24,8 +24,15 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from rmqtt_tpu.broker.overload import CircuitBreaker
 from rmqtt_tpu.cluster import wire
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, FailpointError
 
 log = logging.getLogger("rmqtt_tpu.cluster")
+
+#: chaos seam (utils/failpoints.py): fires on outbound publish-forward
+#: frames only (FORWARDS / FORWARDS_TO) — an injected error is surfaced as
+#: PeerUnavailable and feeds the peer breaker, exactly like a dropped link
+_FP_FORWARD = FAILPOINTS.register("cluster.forward")
+_FORWARD_TYPES = ("forwards", "forwards_to")  # messages.M constants
 
 MAX_FRAME = 8 * 1024 * 1024  # reference caps messages at 4MB (grpc.rs:154)
 
@@ -123,6 +130,12 @@ class PeerClient:
         self._pending.clear()
 
     async def _send(self, obj: dict) -> None:
+        if _FP_FORWARD.action is not None and obj.get("t") in _FORWARD_TYPES:
+            try:
+                await _FP_FORWARD.fire_async()
+            except FailpointError as e:
+                self.breaker.fail()
+                raise PeerUnavailable(str(e)) from e
         await self._ensure()
         assert self._writer is not None
         try:

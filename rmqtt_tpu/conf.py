@@ -316,7 +316,23 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "compact_async": ("routing_compact_async", bool),
         "compact_min_ops": ("routing_compact_min_ops", int),
         "compact_ratio": ("routing_compact_ratio", int),
+        # device-plane failover (broker/failover.py): breaker + watchdog +
+        # switchback knobs around the device router's host fallback
+        "failover": ("failover_enable", bool),
+        "failover_timeout_s": ("failover_timeout_s", float),
+        "failover_threshold": ("failover_threshold", int),
+        "failover_cooldown": ("failover_cooldown", float),
+        "failover_max_cooldown": ("failover_max_cooldown", float),
+        "failover_k_successes": ("failover_k_successes", int),
     }, broker_kwargs)
+    # [failpoints] — fault-injection sites (utils/failpoints.py): quoted
+    # site name → action spec. Validated at load (unknown sites / bad specs
+    # raise when ServerContext applies them); listed here as a free-form
+    # section since the site catalog lives with the registry.
+    fp_tree = tree.get("failpoints", {})
+    if fp_tree:
+        broker_kwargs["failpoints"] = {
+            str(k): str(v) for k, v in fp_tree.items()}
     # [observability] — latency telemetry knobs (broker/telemetry.py):
     # histograms + slow-op ring; enable=false makes every span a no-op.
     # trace_* configure the per-publish tracing layer (broker/tracing.py):

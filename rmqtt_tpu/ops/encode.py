@@ -209,6 +209,16 @@ class FilterTable:
         self.version += 1
         self.delta.mark(self.version, fid)
 
+    def force_full_refresh(self) -> None:
+        """Invalidate every device mirror's delta state: the next refresh
+        must re-upload the WHOLE table (device-plane failover rewarm,
+        broker/failover.py — after an outage the HBM copy may be gone or
+        torn, so no pre-outage journal entry may ever be scattered into
+        it). Bumping the version re-arms the refresh; raising the journal
+        floor past it makes ``since()`` return None (full-upload path)."""
+        self.version += 1
+        self.delta.reset(self.version)
+
     def encode_topics(
         self, topics: Sequence[str | Sequence[str]], pad_batch_to: Optional[int] = None
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -33,7 +33,17 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
 EMA_ALPHA = 0.3  # weight of the newest rate sample
+
+#: chaos seams (utils/failpoints.py): fired ONLY on the device branch —
+#: trie-served batches are host-side work and genuinely unaffected by a
+#: dead/hung accelerator, so injected device faults must not touch them
+#: (in particular, `hang` must never run on the event-loop inline path,
+#: which is trie-only). One attribute test per batch when off.
+_FP_DISPATCH = FAILPOINTS.register("device.dispatch")
+_FP_COMPLETE = FAILPOINTS.register("device.complete")
 
 
 class AdaptiveHybrid:
@@ -47,6 +57,11 @@ class AdaptiveHybrid:
         self._n_large = 0
         self._dev_samples = 0  # first device sample includes XLA compile
         self._last_dev_complete = None  # for pipelined-rate attribution
+        # which backend served the most recent synchronous match — read by
+        # the routing service right after a dispatch (serialized there) so
+        # only DEVICE successes reset the failover breaker's consecutive-
+        # failure count; trie-served batches are not device evidence
+        self.last_backend: Optional[str] = None
         # EMA state is touched from both the submit and the completion
         # executor threads (RoutingService pipelining); the GIL keeps it
         # memory-safe but probe cadence / rate attribution would skew —
@@ -74,6 +89,7 @@ class AdaptiveHybrid:
                 self._bump("device", n / dt)
 
     def _side_match(self, topics: Sequence[str]) -> List[np.ndarray]:
+        self.last_backend = "side"
         t0 = time.perf_counter()
         if len(topics) > 1 and hasattr(self.side, "match_batch"):
             # one native call for the whole batch: the per-topic ctypes
@@ -88,8 +104,13 @@ class AdaptiveHybrid:
         return rows
 
     def _device_match(self, topics: Sequence[str]) -> List[np.ndarray]:
+        self.last_backend = "device"
+        if _FP_DISPATCH.action is not None:
+            _FP_DISPATCH.fire_sync()
         t0 = time.perf_counter()
         rows = self.matcher.match(topics)
+        if _FP_COMPLETE.action is not None:
+            _FP_COMPLETE.fire_sync()
         with self._lock:
             self._bump_device(len(topics), time.perf_counter() - t0)
             self._last_dev_complete = time.perf_counter()
@@ -136,6 +157,9 @@ class AdaptiveHybrid:
             and self._pick() == "device"
         ):
             if hasattr(self.matcher, "match_submit"):
+                self.last_backend = "device"
+                if _FP_DISPATCH.action is not None:
+                    _FP_DISPATCH.fire_sync()
                 return ("device", self.matcher.match_submit(topics),
                         len(topics), time.perf_counter())
             return ("sync", self._device_match(topics))
@@ -145,6 +169,8 @@ class AdaptiveHybrid:
         if handle[0] == "sync":
             return handle[1]
         _kind, payload, n, t_submit = handle
+        if _FP_COMPLETE.action is not None:
+            _FP_COMPLETE.fire_sync()
         rows = self.matcher.match_complete(payload)
         now = time.perf_counter()
         with self._lock:

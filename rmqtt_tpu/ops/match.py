@@ -41,7 +41,7 @@ import numpy as np
 from jax import lax
 
 from rmqtt_tpu.ops.encode import PLUS_TOK, FilterTable
-from rmqtt_tpu.ops.partitioned import _pad_scatter_pow2
+from rmqtt_tpu.ops.partitioned import _FP_UPLOAD, _pad_scatter_pow2
 from rmqtt_tpu.utils.devfetch import fetch
 
 # Filters processed per scan step; bounds per-chunk HBM traffic.
@@ -311,6 +311,10 @@ class TpuMatcher:
         t = self.table
         if self._dev_version == t.version and self._dev_arrays is not None:
             return self._dev_arrays
+        # chaos seam (utils/failpoints.py): an injected upload fault fires
+        # only when a real refresh (delta scatter or full put) is due
+        if _FP_UPLOAD.action is not None:
+            _FP_UPLOAD.fire_sync()
         # capture the version BEFORE reading journal/rows: a mutation
         # landing mid-refresh must stay pending for the next refresh, not
         # be marked uploaded (FilterTable has no lock; the capture makes

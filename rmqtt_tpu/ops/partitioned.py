@@ -39,6 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+#: chaos seam shared by every device-table mirror (PartitionedMatcher,
+#: TpuMatcher, the sharded variants): fires when an HBM refresh — delta
+#: scatter or full pack+put — is about to run (utils/failpoints.py)
+_FP_UPLOAD = FAILPOINTS.register("device.upload")
+
 from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
 from rmqtt_tpu.ops.encode import (
     _FIRST_TOK,
@@ -600,6 +607,19 @@ class PartitionedTable:
         """Churn threshold at which the fragmented layout is worth a
         rebuild (the former ``encode_topics`` inline trigger)."""
         return self.dirty_ops > max(self.compact_min_ops, self.size // self.compact_ratio)
+
+    def force_full_refresh(self) -> None:
+        """Invalidate every device mirror's delta state: the next refresh
+        must re-pack and re-upload the WHOLE table (device-plane failover
+        rewarm, broker/failover.py — after an outage the HBM copy may be
+        gone or torn, so no pre-outage delta may ever be scattered into
+        it). The layout itself is unchanged — rows stay put — so the epoch
+        bump only closes the delta gate; encode caches keyed on the epoch
+        re-validate lazily (encode_topics' cache_epoch check)."""
+        with self._mu:
+            self.version += 1
+            self.layout_epoch += 1
+            self.delta.reset(self.version)
 
     def compact(self) -> None:
         """Synchronous rebuild (build + install). In the broker this never
@@ -1443,6 +1463,10 @@ class PartitionedMatcher:
             self._dev_arrays is not None or self._segments is not None
         ):
             return self._dev_arrays
+        # chaos seam: injected upload faults fire before the table lock so
+        # a `hang` action wedges only this refresh, never subscribes
+        if _FP_UPLOAD.action is not None:
+            _FP_UPLOAD.fire_sync()
         with t._mu:
             if self._dev_version == t.version and (
                 self._dev_arrays is not None or self._segments is not None

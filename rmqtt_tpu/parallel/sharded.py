@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import DEFAULT_CHUNK, match_packed_impl
+from rmqtt_tpu.ops.partitioned import _FP_UPLOAD
 from rmqtt_tpu.utils.devfetch import fetch
 
 # shard_map moved homes across jax releases: stable `jax.shard_map` (new)
@@ -94,6 +95,8 @@ class ShardedMatcher:
     def _refresh(self):
         t = self.table
         if self._dev_version != t.version or self._dev_arrays is None:
+            if _FP_UPLOAD.action is not None:  # chaos seam (failpoints)
+                _FP_UPLOAD.fire_sync()
             shard = lambda arr, spec: jax.device_put(arr, NamedSharding(self.mesh, spec))
             self._dev_arrays = (
                 shard(t.tok, P("fp", None)),
@@ -200,6 +203,8 @@ class ShardedPartitionedMatcher:
         t = self.table
         if self._dev_version == t.version and self._dev_rows is not None:
             return self._dev_rows
+        if _FP_UPLOAD.action is not None:  # chaos seam (utils/failpoints.py)
+            _FP_UPLOAD.fire_sync()
         with t._mu:
             if self._dev_version == t.version and self._dev_rows is not None:
                 return self._dev_rows

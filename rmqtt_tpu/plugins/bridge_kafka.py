@@ -45,6 +45,12 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
 from rmqtt_tpu.router.base import Id
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, fire_async_as
+
+#: chaos seam (utils/failpoints.py), shared by every bridge egress pump: an
+#: injected fault is raised as ConnectionError so it trips the SAME breaker
+#: path a real remote outage would
+_FP_EGRESS = FAILPOINTS.register("bridge.egress")
 
 log = logging.getLogger("rmqtt_tpu.bridge.kafka")
 
@@ -243,6 +249,8 @@ class BridgeEgressKafkaPlugin(Plugin):
             if tid is not None:
                 headers.append(("mqtt_trace_id", tid.encode()))
             try:
+                if _FP_EGRESS.action is not None:
+                    await fire_async_as(_FP_EGRESS)
                 if partition < 0:  # PARTITION_UNASSIGNED: round-robin
                     parts = await self._client.partitions(topic)
                     if not parts:

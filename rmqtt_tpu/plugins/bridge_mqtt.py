@@ -22,6 +22,9 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
 from rmqtt_tpu.router.base import Id
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, fire_async_as
+
+_FP_EGRESS = FAILPOINTS.register("bridge.egress")  # chaos seam (failpoints)
 
 log = logging.getLogger("rmqtt_tpu.bridge")
 
@@ -135,6 +138,13 @@ class BridgeEgressMqttPlugin(Plugin):
                     break
                 except asyncio.TimeoutError:
                     self.breaker.fail()
+            if _FP_EGRESS.action is not None:  # chaos seam (failpoints)
+                try:
+                    await fire_async_as(_FP_EGRESS)
+                except ConnectionError:
+                    self.breaker.fail()
+                    self.ctx.metrics.inc("bridge.egress.errors")
+                    continue
             ok = await self._client.publish(
                 self.remote_prefix + msg.topic, msg.payload, qos=min(msg.qos, 1),
                 retain=msg.retain,

@@ -24,6 +24,9 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
 from rmqtt_tpu.router.base import Id
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, fire_async_as
+
+_FP_EGRESS = FAILPOINTS.register("bridge.egress")  # chaos seam (failpoints)
 
 log = logging.getLogger("rmqtt_tpu.bridge.nats")
 
@@ -131,6 +134,13 @@ class BridgeEgressNatsPlugin(Plugin):
                     break
                 except asyncio.TimeoutError:
                     self.breaker.fail()
+            if _FP_EGRESS.action is not None:  # chaos seam (failpoints)
+                try:
+                    await fire_async_as(_FP_EGRESS)
+                except ConnectionError:
+                    self.breaker.fail()
+                    self.ctx.metrics.inc("bridge.nats.errors")
+                    continue
             ok = await self._client.publish(
                 self.subject_prefix + mqtt_to_nats_subject(msg.topic), msg.payload,
                 headers=[("Mqtt-Trace-Id", tid)] if tid is not None else None,

@@ -44,6 +44,9 @@ from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.core.topic import match_filter
 from rmqtt_tpu.plugins import Plugin
 from rmqtt_tpu.router.base import Id
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, fire_async_as
+
+_FP_EGRESS = FAILPOINTS.register("bridge.egress")  # chaos seam (failpoints)
 
 log = logging.getLogger("rmqtt_tpu.bridge.pulsar")
 
@@ -215,6 +218,8 @@ class BridgeEgressPulsarPlugin(Plugin):
                 props.append(("qos", str(msg.qos)))
                 props.append(("retain", "true" if msg.retain else "false"))
             try:
+                if _FP_EGRESS.action is not None:
+                    await fire_async_as(_FP_EGRESS)
                 await self._ensure_client()
                 await self._client.send(
                     i + 1, next(self._seq), msg.payload, properties=props,

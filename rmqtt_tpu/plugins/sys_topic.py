@@ -119,6 +119,7 @@ class SysTopicPlugin(Plugin):
                 await self._publish_latency()
                 await self._publish_tracing()
             await self._publish_overload()
+            await self._publish_failover()
             await asyncio.sleep(self.interval)
 
     async def _publish_latency(self) -> None:
@@ -162,6 +163,19 @@ class SysTopicPlugin(Plugin):
             await self._publish(
                 f"{self._prefix}/overload/breakers", json.dumps(breakers).encode()
             )
+
+    async def _publish_failover(self) -> None:
+        """$SYS/brokers/<node>/routing/failover: device-plane failover
+        state (broker/failover.py). Published only when the failover plane
+        is wired (device routers with a host fallback) — trie-only brokers
+        keep their $SYS tree unchanged."""
+        fo = getattr(self.ctx.routing, "failover", None)
+        if fo is None:
+            return
+        await self._publish(
+            f"{self._prefix}/routing/failover",
+            json.dumps(fo.snapshot()).encode(),
+        )
 
     async def _publish_tracing(self) -> None:
         """$SYS/brokers/<node>/tracing/#: the tracer's counters/config

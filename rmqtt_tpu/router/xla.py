@@ -19,6 +19,7 @@ import numpy as np
 
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import TpuMatcher
+from rmqtt_tpu.utils.failpoints import FAILPOINTS
 from rmqtt_tpu.router.base import (
     ClientId,
     Id,
@@ -122,18 +123,23 @@ class XlaRouter(Router):
         from rmqtt_tpu.ops.hybrid import AdaptiveHybrid
 
         self._hybrid_max = int(os.environ.get("RMQTT_HYBRID_MAX", "64"))
+        # the mirror is built even with the hybrid fast path disabled
+        # (RMQTT_HYBRID_MAX=0): it doubles as the failover plane's host
+        # fallback table (broker/failover.py), which must stay maintained
+        # precisely in the all-device regime where every batch depends on
+        # the device router. Only the >200K Python-tree drop (add()) may
+        # remove it.
         self._side = None
         self._side_native = False
-        if self._hybrid_max > 0:
-            try:
-                from rmqtt_tpu.runtime import NativeTrie
+        try:
+            from rmqtt_tpu.runtime import NativeTrie
 
-                self._side = NativeTrie()
-                self._side_native = True
-            except Exception:
-                from rmqtt_tpu.core.trie import TopicTree
+            self._side = NativeTrie()
+            self._side_native = True
+        except Exception:
+            from rmqtt_tpu.core.trie import TopicTree
 
-                self._side = _TreeSide(TopicTree())
+            self._side = _TreeSide(TopicTree())
         # large batches route adaptively between the trie mirror and the
         # device (ops/hybrid.py): which path wins depends on table scale
         # and chip placement, so the hybrid measures instead of assuming.
@@ -141,12 +147,21 @@ class XlaRouter(Router):
         # only serves the sub-threshold latency path); RMQTT_HYBRID_ADAPT=0
         # pins large batches to the device.
         probe = int(os.environ.get("RMQTT_PROBE_EVERY", "64"))
-        if not self._side_native or os.environ.get("RMQTT_HYBRID_ADAPT", "1") != "1":
+        if (self._hybrid_max <= 0 or not self._side_native
+                or os.environ.get("RMQTT_HYBRID_ADAPT", "1") != "1"):
+            # hybrid off pins large batches to the device (the mirror then
+            # serves ONLY failover), and adaptivity needs the native trie
             probe = 0
         self._hybrid = AdaptiveHybrid(
             self._side, self.matcher, small_max=self._hybrid_max,
             probe_every=probe,
         )
+        # fault-injection sites (utils/failpoints.py): the hybrid fires
+        # them on its device branch (ops/hybrid.py) so trie-served batches
+        # stay unaffected; the canary below fires them directly because it
+        # bypasses the hybrid to exercise the device matcher on purpose
+        self._fp_dispatch = FAILPOINTS.register("device.dispatch")
+        self._fp_complete = FAILPOINTS.register("device.complete")
 
     def add(self, topic_filter: str, id: Id, opts: SubscriptionOptions) -> None:
         if self._relations.add(topic_filter, id, opts):
@@ -248,6 +263,89 @@ class XlaRouter(Router):
                     time.perf_counter_ns() - t0,
                     {"backend": "xla", "batch": len(items)})
         return self._expand(items, rows)
+
+    def last_match_was_device(self) -> bool:
+        """Did the most recent (synchronously resolved) match run on the
+        DEVICE matcher? The routing service consults this before crediting
+        a success to the failover breaker — the hybrid's trie-served
+        batches say nothing about device health."""
+        return self._hybrid.last_backend == "device"
+
+    # ---- host fallback plane (device-plane failover, broker/failover.py).
+    # The trie mirror is updated synchronously on every add/remove, so the
+    # fallback routes against the CURRENT table — its only staleness is the
+    # >200K-filter regime where the Python-tree mirror is dropped (then
+    # host_available() is False and failover cannot engage).
+    def host_available(self) -> bool:
+        return self._side is not None
+
+    def host_inline_ok(self) -> bool:
+        # the native trie is µs-scale: run failover batches on the event
+        # loop; the Python-tree fallback keeps the executor hop
+        return self._side_native
+
+    def host_matches_batch_raw(self, items: Sequence[Tuple[Optional[Id], str]]):
+        """Match a batch via the host trie mirror ONLY — no device dispatch,
+        no device failpoints. This is the degraded-but-correct routing path
+        the failover plane serves publishes through while the breaker around
+        the device router is open."""
+        side = self._side
+        if side is None:
+            raise RuntimeError("no host-side trie mirror to fail over to")
+        topics = [topic for _, topic in items]
+        if len(topics) > 1 and hasattr(side, "match_batch"):
+            rows = side.match_batch(list(topics))
+        else:
+            rows = [side.match(t) for t in topics]
+        return self._expand(items, rows)
+
+    def device_rewarm(self) -> None:
+        """Force the next device refresh down the FULL pack+upload path
+        (half-open probe prelude): the table's layout-epoch bump closes the
+        delta gate, so no delta journal state from before the outage can be
+        scattered into a table whose device mirror may be gone or torn."""
+        t = self.table
+        if hasattr(t, "force_full_refresh"):
+            t.force_full_refresh()
+
+    def canary_topics(self, k: int = 3) -> List[str]:
+        """Concrete topics derived from up to ``k`` live filters (wildcards
+        substituted with a literal level) so the failover canary compares
+        NON-EMPTY rows whenever the table has routes — a static unmatched
+        topic would make the device-vs-trie oracle vacuously pass on a
+        device that recovered into silently-wrong matches. ``$``-prefixed
+        filters are skipped (their first level has special match rules);
+        an empty result tells the caller to fall back to its static topic."""
+        out: List[str] = []
+        for filt in self._filter_to_fid:
+            if len(out) >= k:
+                break
+            if filt.startswith("$"):
+                continue
+            out.append("/".join(
+                "canary" if lvl in ("+", "#") else lvl
+                for lvl in filt.split("/")))
+        return out
+
+    def device_canary(self, topics: Sequence[str]) -> bool:
+        """One canary match through the DEVICE matcher (bypassing the
+        hybrid's trie routing), checked against the host trie oracle. The
+        device failpoints stay armed here so a still-injected fault keeps
+        the breaker open; the first canary after ``device_rewarm`` performs
+        the full HBM re-upload."""
+        if self._fp_dispatch.action is not None:
+            self._fp_dispatch.fire_sync()
+        rows = self.matcher.match(list(topics))
+        if self._fp_complete.action is not None:
+            self._fp_complete.fire_sync()
+        if self._side is None:
+            return True
+        for topic, fids in zip(topics, rows):
+            want = np.sort(np.asarray(self._side.match(topic), dtype=np.int64))
+            got = np.sort(np.asarray(fids, dtype=np.int64))
+            if want.shape != got.shape or not np.array_equal(want, got):
+                return False
+        return True
 
     def device_stats(self) -> Dict[str, float]:
         """Device-table lifecycle counters for RoutingService.stats():

@@ -16,8 +16,13 @@ Data model (per logical namespace ``ns``):
   ``count`` and snapshot ``scan`` without server-wide SCAN walks.
 
 The client is synchronous (the store API is synchronous; broker-control
-rates), pipelines every bulk operation into one socket write, and retries
-once through a reconnect on a dropped connection.
+rates), pipelines every bulk operation into one socket write, and rides
+out dropped connections with the breaker's bounded exponential-backoff
+schedule (`broker/overload.backoff_delays`) — reconnect, back off, retry,
+surface the error on exhaustion (never an infinite retry). The
+``storage.write`` / ``storage.read`` failpoints (utils/failpoints.py) fire
+at the store surface so chaos tests can inject connection-drop-shaped
+faults without a real redis.
 """
 
 from __future__ import annotations
@@ -29,6 +34,24 @@ from typing import Any, List, Optional, Tuple
 from urllib.parse import unquote, urlparse
 
 from rmqtt_tpu.cluster import wire
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, fire_sync_as
+
+_FP_WRITE = FAILPOINTS.register("storage.write")
+_FP_READ = FAILPOINTS.register("storage.read")
+
+#: bounded reconnect-retry: 3 sleeps of 50/100/200ms (+jitter) between
+#: attempts — rides out a redis restart/failover blip without parking the
+#: caller (store ops run on executor threads for the network backend)
+_RETRY_ATTEMPTS = 4
+_RETRY_BASE_S = 0.05
+_RETRY_CAP_S = 0.2
+
+
+def _fire(fp) -> None:
+    """Store-surface chaos seam: an injected error is raised as
+    ConnectionError so it exercises the SAME transient path (bounded
+    reconnect-retry, then surfacing) a real drop would."""
+    fire_sync_as(fp, ConnectionError)
 
 
 class RespError(RuntimeError):
@@ -149,22 +172,31 @@ class RedisClient:
         assert self._sock is not None
         self._sock.sendall(b"".join(cmds))
 
-    def call(self, *args):
-        (r,) = self.pipeline([args])
+    def call(self, *args, fp=None):
+        (r,) = self.pipeline([args], fp=fp)
         return r
 
-    def pipeline(self, commands: List[Tuple]) -> List[Any]:
+    def pipeline(self, commands: List[Tuple], fp=None) -> List[Any]:
         """Send every command in one write; read all replies in order.
-        One reconnect-and-retry on a dropped connection — redis commands
-        used here are idempotent upserts/deletes. An in-band ``-ERR`` reply
-        mid-batch drains the REMAINING replies before raising (leaving them
-        buffered would desync every later call into reading stale replies),
-        then drops the connection for a clean slate — our command set never
-        nests errors inside arrays, but a fresh connection is proof."""
+        Dropped connections reconnect and retry through the bounded
+        backoff schedule (module docstring) — redis commands used here are
+        idempotent upserts/deletes — and surface on exhaustion. An in-band
+        ``-ERR`` reply mid-batch drains the REMAINING replies before
+        raising (leaving them buffered would desync every later call into
+        reading stale replies), then drops the connection for a clean
+        slate — our command set never nests errors inside arrays, but a
+        fresh connection is proof. ``fp`` is the store-surface failpoint:
+        it fires INSIDE the attempt loop so an injected fault is handled
+        exactly like a real drop (reconnect, back off, retry)."""
+        from rmqtt_tpu.broker.overload import backoff_delays
+
         payload = [encode_command(*c) for c in commands]
         with self._lock:
-            for attempt in (0, 1):
+            delays = backoff_delays(_RETRY_ATTEMPTS, _RETRY_BASE_S, _RETRY_CAP_S)
+            while True:
                 try:
+                    if fp is not None and fp.action is not None:
+                        _fire(fp)
                     if self._sock is None:
                         self._connect()
                     self._send_all(payload)
@@ -182,9 +214,10 @@ class RedisClient:
                     return out
                 except (ConnectionError, socket.timeout, OSError):
                     self.close()
-                    if attempt:
+                    d = next(delays, None)
+                    if d is None:
                         raise
-        raise AssertionError("unreachable")
+                    time.sleep(d)
 
 
 class RedisStore:
@@ -237,10 +270,10 @@ class RedisStore:
                 cmds.append(("PERSIST", self._k(ns, k)))
             cmds.append(("SADD", self._nsk(ns), k))
         if cmds:
-            self._c.pipeline(cmds)
+            self._c.pipeline(cmds, fp=_FP_WRITE)
 
     def get(self, ns: str, key: str) -> Optional[Any]:
-        raw = self._c.call("GET", self._k(ns, key))
+        raw = self._c.call("GET", self._k(ns, key), fp=_FP_READ)
         return None if raw is None else wire.loads(raw)
 
     def get_many(self, ns: str, keys) -> List[Optional[Any]]:
@@ -248,18 +281,20 @@ class RedisStore:
         keys = list(keys)
         if not keys:
             return []
-        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys])
+        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys],
+                            fp=_FP_READ)
         return [None if raw is None else wire.loads(raw) for raw in vals]
 
     def delete(self, ns: str, key: str) -> bool:
         n, _ = self._c.pipeline([
-            ("DEL", self._k(ns, key)), ("SREM", self._nsk(ns), key)])
+            ("DEL", self._k(ns, key)), ("SREM", self._nsk(ns), key)],
+            fp=_FP_WRITE)
         return bool(n)
 
     def delete_int_upto(self, ns: str, n: int) -> int:
         """Delete every key whose integer value is <= n (raft log
         compaction: keys are 1-based absolute log indices)."""
-        members = self._c.call("SMEMBERS", self._nsk(ns)) or []
+        members = self._c.call("SMEMBERS", self._nsk(ns), fp=_FP_READ) or []
         victims = []
         for m in members:
             k = m.decode()
@@ -272,15 +307,16 @@ class RedisStore:
             return 0
         cmds = [("DEL", *[self._k(ns, k) for k in victims]),
                 ("SREM", self._nsk(ns), *victims)]
-        deleted, _ = self._c.pipeline(cmds)
+        deleted, _ = self._c.pipeline(cmds, fp=_FP_WRITE)
         return int(deleted)
 
     def scan(self, ns: str) -> List[Tuple[str, Any]]:
-        members = self._c.call("SMEMBERS", self._nsk(ns)) or []
+        members = self._c.call("SMEMBERS", self._nsk(ns), fp=_FP_READ) or []
         if not members:
             return []
         keys = [m.decode() for m in members]
-        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys])
+        vals = self._c.call("MGET", *[self._k(ns, k) for k in keys],
+                            fp=_FP_READ)
         out: List[Tuple[str, Any]] = []
         gone: List[str] = []
         for k, raw in zip(keys, vals):
@@ -298,7 +334,7 @@ class RedisStore:
         # UPPER BOUND between sweeps — callers using it as a limit gauge
         # (max_stored) must run expire_sweep periodically (the
         # message-storage flush loop does)
-        return int(self._c.call("SCARD", self._nsk(ns)) or 0)
+        return int(self._c.call("SCARD", self._nsk(ns), fp=_FP_READ) or 0)
 
     def expire_sweep(self) -> int:
         """Redis expires keys itself; this self-heals the per-ns indexes

@@ -4,10 +4,18 @@ The sled-equivalent embedded backend (reference `rmqtt-storage`): small
 synchronous operations on the event loop are acceptable at broker-control
 rates; bulk scans run in the default executor. WAL mode keeps writers from
 blocking readers across broker restarts/chaos tests.
+
+Transient-fault hardening: SQLITE_BUSY/SQLITE_LOCKED (another process on
+the same WAL file — multi-worker brokers share raft/session DBs) retries
+with the breaker's bounded exponential-backoff schedule
+(`broker/overload.backoff_delays`) before surfacing; the ``storage.write``
+/ ``storage.read`` failpoints (utils/failpoints.py) fire inside that loop
+so chaos tests can prove both the retry and the exhaustion path.
 """
 
 from __future__ import annotations
 
+import asyncio
 import sqlite3
 import threading
 import time
@@ -15,6 +23,63 @@ from pathlib import Path
 from typing import Any, Iterable, List, Optional, Tuple
 
 from rmqtt_tpu.cluster import wire
+from rmqtt_tpu.utils.failpoints import FAILPOINTS, FailpointError
+
+_FP_WRITE = FAILPOINTS.register("storage.write")
+_FP_READ = FAILPOINTS.register("storage.read")
+
+#: bounded retry for busy/locked: 5 sleeps of 10/20/40/80/100ms (+jitter),
+#: ~0.3s worst case — long enough to ride out a peer's WAL checkpoint,
+#: short enough that a genuinely wedged DB errors out while callers still
+#: hold context (no infinite retry; exhaustion surfaces the original error)
+_RETRY_ATTEMPTS = 6
+_RETRY_BASE_S = 0.01
+_RETRY_CAP_S = 0.1
+#: per-sleep cap when the calling thread runs an asyncio event loop —
+#: blocking the loop 0.3s per busy op would stall every connection
+_RETRY_CAP_LOOP_S = 0.01
+
+
+def _transient(e: BaseException) -> bool:
+    if isinstance(e, FailpointError):
+        return True  # injected faults model busy/locked: exercise the retry
+    if not isinstance(e, sqlite3.OperationalError):
+        return False
+    s = str(e).lower()
+    return "locked" in s or "busy" in s
+
+
+def _with_retry(fp, op):
+    """Run one store op; transient errors sleep through the bounded
+    backoff schedule, anything else (or exhaustion) raises.
+
+    Small synchronous ops legitimately run ON the event loop (the store's
+    documented contract), so when this thread has a running loop the
+    schedule is truncated to ``_RETRY_CAP_LOOP_S`` per sleep (~tens of ms
+    total) — enough to ride out a WAL-checkpoint SQLITE_BUSY, but a busy
+    peer can never freeze every connection for the full ~0.3s worst case.
+    Executor-thread callers (expire sweeps, network-parity paths) keep the
+    full schedule."""
+    from rmqtt_tpu.broker.overload import backoff_delays
+
+    try:
+        asyncio.get_running_loop()
+        cap = _RETRY_CAP_LOOP_S
+    except RuntimeError:
+        cap = _RETRY_CAP_S
+    delays = backoff_delays(_RETRY_ATTEMPTS, _RETRY_BASE_S, cap)
+    while True:
+        try:
+            if fp.action is not None:
+                fp.fire_sync()
+            return op()
+        except (sqlite3.OperationalError, FailpointError) as e:
+            if not _transient(e):
+                raise
+            d = next(delays, None)
+            if d is None:
+                raise
+            time.sleep(min(d, cap))
 
 
 class SqliteStore:
@@ -52,12 +117,17 @@ class SqliteStore:
     # ------------------------------------------------------------------ kv
     def put(self, ns: str, key: str, value: Any, ttl: Optional[float] = None) -> None:
         expire = time.time() + ttl if ttl else None
-        with self._lock:
-            self._db.execute(
-                "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
-                (ns, key, wire.dumps(value), expire),
-            )
-            self._db.commit()
+        blob = wire.dumps(value)
+
+        def op():
+            with self._lock:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+                    (ns, key, blob, expire),
+                )
+                self._db.commit()
+
+        _with_retry(_FP_WRITE, op)
 
     def put_many(self, ns: str, items) -> None:
         """Bulk upsert in ONE transaction (large raft appends must not pay a
@@ -67,18 +137,26 @@ class SqliteStore:
     def put_many_expire(self, ns: str, items) -> None:
         """Bulk upsert with per-item absolute expiry: (key, value,
         expire_at_or_None) triples, one transaction."""
-        with self._lock:
-            self._db.executemany(
-                "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
-                [(ns, k, wire.dumps(v), exp) for k, v, exp in items],
-            )
-            self._db.commit()
+        rows = [(ns, k, wire.dumps(v), exp) for k, v, exp in items]
+
+        def op():
+            with self._lock:
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO kv (ns, k, v, expire_at) VALUES (?,?,?,?)",
+                    rows,
+                )
+                self._db.commit()
+
+        _with_retry(_FP_WRITE, op)
 
     def get(self, ns: str, key: str) -> Optional[Any]:
-        with self._lock:
-            row = self._db.execute(
-                "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
-            ).fetchone()
+        def op():
+            with self._lock:
+                return self._db.execute(
+                    "SELECT v, expire_at FROM kv WHERE ns=? AND k=?", (ns, key)
+                ).fetchone()
+
+        row = _with_retry(_FP_READ, op)
         if row is None:
             return None
         value, expire = row
@@ -92,27 +170,37 @@ class SqliteStore:
         return [self.get(ns, k) for k in keys]
 
     def delete(self, ns: str, key: str) -> bool:
-        with self._lock:
-            cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))
-            self._db.commit()
-            return cur.rowcount > 0
+        def op():
+            with self._lock:
+                cur = self._db.execute("DELETE FROM kv WHERE ns=? AND k=?", (ns, key))
+                self._db.commit()
+                return cur.rowcount > 0
+
+        return _with_retry(_FP_WRITE, op)
 
     def delete_int_upto(self, ns: str, n: int) -> int:
         """Delete every key whose integer value is <= n (raft log compaction:
         keys are 1-based absolute log indices)."""
-        with self._lock:
-            cur = self._db.execute(
-                "DELETE FROM kv WHERE ns = ? AND CAST(k AS INTEGER) <= ?", (ns, n)
-            )
-            self._db.commit()
-            return cur.rowcount
+        def op():
+            with self._lock:
+                cur = self._db.execute(
+                    "DELETE FROM kv WHERE ns = ? AND CAST(k AS INTEGER) <= ?", (ns, n)
+                )
+                self._db.commit()
+                return cur.rowcount
+
+        return _with_retry(_FP_WRITE, op)
 
     def scan(self, ns: str) -> List[Tuple[str, Any]]:
         nw = time.time()
-        with self._lock:
-            rows = self._db.execute(
-                "SELECT k, v, expire_at FROM kv WHERE ns=?", (ns,)
-            ).fetchall()
+
+        def op():
+            with self._lock:
+                return self._db.execute(
+                    "SELECT k, v, expire_at FROM kv WHERE ns=?", (ns,)
+                ).fetchall()
+
+        rows = _with_retry(_FP_READ, op)
         out = []
         for k, v, expire in rows:
             if expire is not None and expire <= nw:
@@ -121,16 +209,22 @@ class SqliteStore:
         return out
 
     def count(self, ns: str) -> int:
-        with self._lock:
-            (n,) = self._db.execute(
-                "SELECT COUNT(*) FROM kv WHERE ns=?", (ns,)).fetchone()
-        return int(n)
+        def op():
+            with self._lock:
+                (n,) = self._db.execute(
+                    "SELECT COUNT(*) FROM kv WHERE ns=?", (ns,)).fetchone()
+            return int(n)
+
+        return _with_retry(_FP_READ, op)
 
     def expire_sweep(self) -> int:
-        with self._lock:
-            cur = self._db.execute(
-                "DELETE FROM kv WHERE expire_at IS NOT NULL AND expire_at <= ?",
-                (time.time(),)
-            )
-            self._db.commit()
-            return cur.rowcount
+        def op():
+            with self._lock:
+                cur = self._db.execute(
+                    "DELETE FROM kv WHERE expire_at IS NOT NULL AND expire_at <= ?",
+                    (time.time(),)
+                )
+                self._db.commit()
+                return cur.rowcount
+
+        return _with_retry(_FP_WRITE, op)

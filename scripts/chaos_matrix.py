@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Chaos matrix: fire every failpoint once under live traffic → JSON verdict.
+
+Each cell arms one (site, action) against a real broker (real sockets, real
+MQTT clients), drives publishes through the fault window, and checks the
+site's survival contract:
+
+- device.* — the failover plane serves every publish from the host trie
+  (zero lost, QoS1-acked) and switches back after the fault clears;
+- storage.* — the bounded-backoff retry rides the injected faults out and
+  the operation lands (retained message persisted / scanned);
+- cluster.forward — the hit forward surfaces cleanly (no wedge) and the
+  next publish crosses the link;
+- bridge.egress — the bridge pump counts the failure against its breaker
+  and delivers the next message.
+
+Run: ``python scripts/chaos_matrix.py [--out chaos_matrix.json] [--cells a,b]``
+Exit code 0 iff every cell passes. A fast subset of these cells runs in
+tier-1 via tests/test_failpoints.py::test_chaos_matrix_fast_subset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext  # noqa: E402
+from rmqtt_tpu.broker.server import MqttBroker  # noqa: E402
+from rmqtt_tpu.utils.failpoints import FAILPOINTS  # noqa: E402
+
+from tests.mqtt_client import TestClient  # noqa: E402
+
+
+def _device_broker(**cfg):
+    """An xla broker with every batch pinned to the device plane (the trie
+    mirror stays alive as the failover target)."""
+    b = MqttBroker(ServerContext(BrokerConfig(
+        port=0, router="xla", route_cache=False,
+        failover_cooldown=0.3, failover_threshold=2,
+        failover_k_successes=2, **cfg)))
+    r = b.ctx.router
+    r._hybrid_max = 0
+    r._hybrid.small_max = 0
+    r._hybrid.probe_every = 0
+    return b
+
+
+async def _pump(broker, pub, sub, n, phase):
+    """n QoS1 publishes; returns the (topic, payload) set sent."""
+    sent = set()
+    for i in range(n):
+        t, p = f"m/{i % 3}", f"{phase}-{i}".encode()
+        await pub.publish(t, p, qos=1)
+        sent.add((t, p))
+    return sent
+
+
+async def _drain(sub, want):
+    got = set()
+    while len(got) < len(want):
+        p = await sub.recv(timeout=10.0)
+        got.add((p.topic, p.payload))
+    return got
+
+
+async def cell_device(site: str, action: str) -> dict:
+    b = _device_broker(failover_timeout_s=(0.5 if action == "hang" else 30.0))
+    await b.start()
+    fo = b.ctx.routing.failover
+    fp = FAILPOINTS.point(site)
+    base = fp.triggers
+    try:
+        sub = await TestClient.connect(b.port, "cm-sub")
+        await sub.subscribe("m/#", qos=1)
+        pub = await TestClient.connect(b.port, "cm-pub")
+        sent = await _pump(b, pub, sub, 4, "warm")  # healthy + JIT warm
+        if site == "device.upload":
+            # an upload fault only fires when a refresh is due: dirty the
+            # table mid-window so the next device batch re-uploads
+            FAILPOINTS.set(site, action)
+            from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+            b.ctx.router.add("m/extra/+", Id(1, "cm-x"),
+                             SubscriptionOptions(qos=0))
+        else:
+            FAILPOINTS.set(site, action)
+        sent |= await _pump(b, pub, sub, 6, "fault")  # through the fault
+        FAILPOINTS.set(site, "off")
+        deadline = time.time() + 30
+        while fo.active and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        sent |= await _pump(b, pub, sub, 4, "post")
+        got = await _drain(sub, sent)
+        return {
+            "ok": got == sent and not fo.active and fp.triggers > base,
+            "sent": len(sent), "received": len(got),
+            "triggers": fp.triggers - base, "failovers": fo.failovers,
+            "switchbacks": fo.switchbacks, "host_routed": fo.host_items,
+            "failures": {k: v for k, v in fo.failures.items() if v},
+        }
+    finally:
+        FAILPOINTS.clear_all()
+        await b.stop()
+
+
+async def cell_storage(site: str, action: str) -> dict:
+    import tempfile
+
+    from rmqtt_tpu.plugins.retainer import NS, RetainerPlugin
+
+    b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+    with tempfile.TemporaryDirectory() as td:
+        plug = RetainerPlugin(b.ctx, {"path": f"{td}/retain.db"})
+        b.ctx.plugins.register(plug)
+        await b.start()
+        fp = FAILPOINTS.point(site)
+        base = fp.triggers
+        try:
+            pub = await TestClient.connect(b.port, "cm-pub")
+            FAILPOINTS.set(site, action)
+            # live traffic THROUGH the fault: the retained write persists
+            # via the bounded retry on the storage surface
+            await pub.publish("st/keep", b"v1", qos=1, retain=True)
+            rows = dict(plug.store.scan(NS))  # read path (scan) under fault
+            FAILPOINTS.set(site, "off")
+            sub = await TestClient.connect(b.port, "cm-sub")
+            await sub.subscribe("st/#", qos=1)
+            p = await sub.recv(timeout=5.0)
+            return {
+                "ok": (p.payload == b"v1" and p.retain
+                       and "st/keep" in rows and fp.triggers > base),
+                "triggers": fp.triggers - base,
+                "persisted": len(rows),
+            }
+        finally:
+            FAILPOINTS.clear_all()
+            await b.stop()
+
+
+async def cell_cluster(site: str, action: str) -> dict:
+    from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+    from rmqtt_tpu.cluster.transport import PeerClient
+
+    brokers = []
+    clusters = []
+    try:
+        for nid in (1, 2):
+            ctx = ServerContext(BrokerConfig(port=0, node_id=nid, cluster=True))
+            br = MqttBroker(ctx)
+            await br.start()
+            brokers.append(br)
+        for br in brokers:
+            c = BroadcastCluster(br.ctx, ("127.0.0.1", 0), [])
+            await c.start()
+            clusters.append(c)
+        for i, c in enumerate(clusters):
+            for j, other in enumerate(clusters):
+                if i != j:
+                    nid = brokers[j].ctx.node_id
+                    c.peers[nid] = PeerClient(nid, "127.0.0.1", other.bound_port)
+            c.bcast.peers = list(c.peers.values())
+        sub = await TestClient.connect(brokers[1].port, "cm-sub")
+        await sub.subscribe("x/#", qos=1)
+        pub = await TestClient.connect(brokers[0].port, "cm-pub")
+        await pub.publish("x/warm", b"w", qos=1)
+        p = await sub.recv(timeout=5.0)
+        assert p.payload == b"w"
+        fp = FAILPOINTS.point(site)
+        base = fp.triggers
+        FAILPOINTS.set(site, action)  # times(1, error): ONE forward dropped
+        await pub.publish("x/hit", b"h", qos=1)  # publisher still acked
+        FAILPOINTS.set(site, "off")
+        await pub.publish("x/after", b"a", qos=1)
+        got = []
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 1:
+            try:
+                got.append((await sub.recv(timeout=1.0)).payload)
+            except asyncio.TimeoutError:
+                break
+        # contract: the broker never wedges; the post-fault publish crosses
+        return {"ok": b"a" in got and fp.triggers > base,
+                "triggers": fp.triggers - base,
+                "delivered_after": [g.decode() for g in got]}
+    finally:
+        FAILPOINTS.clear_all()
+        for c in clusters:
+            await c.stop()
+        for br in brokers:
+            await br.stop()
+
+
+async def cell_bridge(site: str, action: str) -> dict:
+    from rmqtt_tpu.plugins.bridge_mqtt import BridgeEgressMqttPlugin
+
+    remote = MqttBroker(ServerContext(BrokerConfig(port=0)))
+    await remote.start()
+    local = MqttBroker(ServerContext(BrokerConfig(port=0)))
+    local.ctx.plugins.register(BridgeEgressMqttPlugin(local.ctx, {
+        "port": remote.port, "forwards": ["br/#"]}))
+    await local.start()
+    try:
+        watch = await TestClient.connect(remote.port, "cm-watch")
+        await watch.subscribe("br/#", qos=1)
+        pub = await TestClient.connect(local.port, "cm-pub")
+        await pub.publish("br/warm", b"w", qos=0)
+        p = await watch.recv(timeout=10.0)
+        assert p.payload == b"w"
+        fp = FAILPOINTS.point(site)
+        base = fp.triggers
+        FAILPOINTS.set(site, action)  # times(1, error): one egress fails
+        await pub.publish("br/hit", b"h", qos=0)
+        deadline = time.time() + 5
+        while fp.triggers == base and time.time() < deadline:
+            await asyncio.sleep(0.02)  # let the drain pump hit the fault
+        FAILPOINTS.set(site, "off")
+        await pub.publish("br/after", b"a", qos=0)
+        got = set()
+        deadline = time.time() + 8
+        while time.time() < deadline and b"a" not in got:
+            try:
+                got.add((await watch.recv(timeout=1.0)).payload)
+            except asyncio.TimeoutError:
+                break
+        errors = local.ctx.metrics.get("bridge.egress.errors")
+        return {"ok": b"a" in got and fp.triggers > base and errors >= 1,
+                "triggers": fp.triggers - base,
+                "egress_errors": errors,
+                "delivered_after": sorted(g.decode() for g in got)}
+    finally:
+        FAILPOINTS.clear_all()
+        await local.stop()
+        await remote.stop()
+
+
+#: the matrix: every registered site fired at least once under live traffic
+MATRIX = {
+    "device.dispatch:error": lambda: cell_device("device.dispatch", "times(3, error)"),
+    "device.dispatch:delay": lambda: cell_device("device.dispatch", "times(3, delay(20))"),
+    "device.complete:error": lambda: cell_device("device.complete", "times(3, error)"),
+    "device.complete:hang": lambda: cell_device("device.complete", "hang"),
+    "device.upload:error": lambda: cell_device("device.upload", "times(1, error)"),
+    "storage.write:error": lambda: cell_storage("storage.write", "times(2, error)"),
+    "storage.read:error": lambda: cell_storage("storage.read", "times(2, error)"),
+    "cluster.forward:error": lambda: cell_cluster("cluster.forward", "times(1, error)"),
+    "bridge.egress:error": lambda: cell_bridge("bridge.egress", "times(1, error)"),
+}
+
+#: tier-1 subset (fast, no hang/delay cells): run by tests/test_failpoints.py
+FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
+               "bridge.egress:error"]
+
+
+async def run_matrix(cells=None) -> dict:
+    names = list(cells) if cells else list(MATRIX)
+    results = {}
+    for name in names:
+        t0 = time.time()
+        try:
+            verdict = await asyncio.wait_for(MATRIX[name](), timeout=120.0)
+        except Exception as e:  # a crashed cell is a failed cell
+            verdict = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        verdict["seconds"] = round(time.time() - t0, 2)
+        results[name] = verdict
+        print(f"[{'PASS' if verdict['ok'] else 'FAIL'}] {name} "
+              f"({verdict['seconds']}s)", flush=True)
+    return {
+        "ok": all(v["ok"] for v in results.values()),
+        "cells": results,
+        "sites_covered": sorted({n.split(":")[0] for n in names}),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="chaos_matrix.json")
+    ap.add_argument("--cells", default="",
+                    help="comma-separated cell names (default: all)")
+    args = ap.parse_args()
+    cells = [c for c in args.cells.split(",") if c] or None
+    verdict = asyncio.run(run_matrix(cells))
+    Path(args.out).write_text(json.dumps(verdict, indent=2) + "\n")
+    print(f"verdict → {args.out} (ok={verdict['ok']})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

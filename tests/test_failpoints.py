@@ -1,0 +1,790 @@
+"""Failpoint registry + device-plane failover tests.
+
+Covers the ISSUE-6 contract: registry/action semantics (prob/times/delay/
+hang), the all-off zero-cost pin (fire is never entered when a site is
+off), conf/env/HTTP configuration surfaces, storage retry integration, the
+cluster.forward transport seam, and the breaker-driven device→host→device
+failover E2E with the forced full re-upload on recovery.
+"""
+
+import asyncio
+import pathlib
+import random
+import re
+import threading
+import time
+
+import pytest
+
+from rmqtt_tpu.utils.failpoints import (
+    FAILPOINTS,
+    Failpoint,
+    FailpointError,
+    FailpointRegistry,
+    SITES,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """The registry is process-global: never leak an armed fault."""
+    FAILPOINTS.clear_all()
+    yield
+    FAILPOINTS.clear_all()
+
+
+def run_async(fn, timeout=60.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+# ------------------------------------------------------------ registry/specs
+def test_catalog_preregistered():
+    for name, _help in SITES:
+        fp = FAILPOINTS.point(name)
+        assert fp.action is None and fp.spec == "off"
+    # register() is idempotent: sites fetch the shared instance
+    assert FAILPOINTS.register("device.dispatch") is FAILPOINTS.point("device.dispatch")
+
+
+def test_unknown_site_and_bad_specs_raise():
+    with pytest.raises(ValueError):
+        FAILPOINTS.set("no.such.site", "error")
+    for bad in ("explode", "delay(-5)", "prob(1.5, error)", "times(0, error)",
+                "prob(0.5, off)", "times(2, prob(0.5, error))", "delay(x)",
+                "prob(0.5)"):
+        with pytest.raises(ValueError):
+            FAILPOINTS.set("device.dispatch", bad)
+    # a bad spec must not half-arm the site
+    assert FAILPOINTS.point("device.dispatch").action is None
+
+
+def test_error_and_delay_actions():
+    fp = FailpointRegistry().point("device.dispatch")
+    fp.set("error(boom)")
+    with pytest.raises(FailpointError, match="boom"):
+        fp.fire_sync()
+    fp.set("delay(30)")
+    t0 = time.perf_counter()
+    fp.fire_sync()
+    assert time.perf_counter() - t0 >= 0.025
+    assert fp.triggers == 2
+    fp.clear()
+    assert fp.action is None and fp.spec == "off"
+
+
+def test_times_action_budget():
+    fp = FailpointRegistry().point("storage.write")
+    fp.set("times(3, error)")
+    for _ in range(3):
+        with pytest.raises(FailpointError):
+            fp.fire_sync()
+    fp.fire_sync()  # budget exhausted: no-op
+    fp.fire_sync()
+    snap = fp.snapshot()
+    assert snap["triggers"] == 3 and snap["times_left"] == 0
+    fp.set("times(1, error)")  # re-arming refills the budget
+    with pytest.raises(FailpointError):
+        fp.fire_sync()
+
+
+def test_prob_action_rate():
+    reg = FailpointRegistry(rng=random.Random(42))
+    fp = reg.point("storage.read")
+    fp.set("prob(0.3, error)")
+    fired = 0
+    for _ in range(1000):
+        try:
+            fp.fire_sync()
+        except FailpointError:
+            fired += 1
+    assert 230 <= fired <= 370  # ~0.3 ± sampling noise, seeded rng
+    assert fp.evaluations == 1000 and fp.triggers == fired
+
+
+def test_hang_heals_on_reconfigure():
+    fp = FailpointRegistry().point("device.complete")
+    fp.set("hang")
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (fp.fire_sync(), done.set()), daemon=True)
+    t.start()
+    assert not done.wait(0.15)  # genuinely parked
+    fp.clear()  # the operator flips it off → the site unwedges
+    assert done.wait(2.0)
+    t.join(2.0)
+
+
+def test_off_cost_pin(monkeypatch):
+    """All-off discipline: the ONLY hot-path state is ``fp.action is None``
+    — sites guard with that attribute test and never enter fire_sync/
+    fire_async. Pinned by making any entry an immediate failure."""
+    for name, _ in SITES:
+        assert FAILPOINTS.point(name).action is None
+
+    def boom(self):
+        raise AssertionError("fire entered while off")
+
+    monkeypatch.setattr(Failpoint, "_resolve", boom)
+    from rmqtt_tpu.ops.hybrid import _FP_DISPATCH
+
+    base = {n: FAILPOINTS.point(n).evaluations for n, _ in SITES}
+    # the guard the sites use: one attribute load + is-test, nothing else
+    if _FP_DISPATCH.action is not None:
+        _FP_DISPATCH.fire_sync()
+    # evaluations untouched: off sites never count
+    assert all(FAILPOINTS.point(n).evaluations == base[n] for n, _ in SITES)
+
+
+def test_env_string_configure():
+    reg = FailpointRegistry()
+    reg.configure_env("device.dispatch=error; storage.write = delay(5) ;")
+    assert reg.point("device.dispatch").spec == "error"
+    assert reg.point("storage.write").spec == "delay(5)"
+    with pytest.raises(ValueError):
+        reg.configure_env("just-a-word")
+
+
+def test_conf_section_wiring(tmp_path):
+    """[failpoints] flows file → BrokerConfig → the process registry, with
+    RMQTT_FAILPOINTS re-applied on top (env outranks file)."""
+    from rmqtt_tpu import conf
+    from rmqtt_tpu.broker.context import ServerContext
+
+    p = tmp_path / "b.toml"
+    p.write_text(
+        "[node]\nid = 1\n"
+        "[failpoints]\n\"storage.read\" = \"delay(1)\"\n"
+        "\"storage.write\" = \"error\"\n"
+    )
+    settings = conf.load(str(p))
+    assert settings.broker.failpoints == {
+        "storage.read": "delay(1)", "storage.write": "error"}
+    import os
+
+    os.environ["RMQTT_FAILPOINTS"] = "storage.write=off"
+    try:
+        ServerContext(settings.broker)
+    finally:
+        del os.environ["RMQTT_FAILPOINTS"]
+    assert FAILPOINTS.point("storage.read").spec == "delay(1)"
+    assert FAILPOINTS.point("storage.write").spec == "off"  # env won
+
+
+def test_readme_catalog_in_sync():
+    """The README "Failure domains & failover" catalog lists exactly the
+    registered sites — a new site without documentation fails here."""
+    readme = (pathlib.Path(__file__).parent.parent / "README.md").read_text()
+    section = readme.split("### Failure domains & failover", 1)[1]
+    documented = set(re.findall(r"^- `([a-z]+\.[a-z_]+)`", section, re.M))
+    assert documented == {name for name, _ in SITES}
+
+
+# ------------------------------------------------------------------- storage
+def test_sqlite_transient_retry_and_exhaustion(tmp_path):
+    from rmqtt_tpu.storage.sqlite import SqliteStore
+
+    st = SqliteStore(str(tmp_path / "kv.db"))
+    # two injected failures ride the bounded backoff, then the op lands
+    base = FAILPOINTS.point("storage.write").triggers
+    FAILPOINTS.set("storage.write", "times(2, error)")
+    st.put("ns", "k", {"v": 1})
+    assert FAILPOINTS.point("storage.write").triggers - base == 2
+    FAILPOINTS.set("storage.read", "times(1, error)")
+    assert st.get("ns", "k") == {"v": 1}
+    # a persistent fault exhausts the schedule and surfaces (no infinite
+    # retry): 6 attempts per op
+    FAILPOINTS.set("storage.write", "error")
+    t0 = time.perf_counter()
+    with pytest.raises(FailpointError):
+        st.put("ns", "k2", 2)
+    assert time.perf_counter() - t0 < 2.0  # bounded, not parked
+    FAILPOINTS.clear_all()
+    assert st.get("ns", "k2") is None and st.get("ns", "k") == {"v": 1}
+
+
+def test_sqlite_real_locked_error_retries(tmp_path, monkeypatch):
+    """A real SQLITE_BUSY (not just the failpoint) rides the same loop."""
+    import sqlite3
+
+    from rmqtt_tpu.storage import sqlite as sq
+
+    st = sq.SqliteStore(str(tmp_path / "kv.db"))
+    calls = {"n": 0}
+    real_db = st._db
+
+    class FlakyDb:
+        def execute(self, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise sqlite3.OperationalError("database is locked")
+            return real_db.execute(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(real_db, name)
+
+    monkeypatch.setattr(st, "_db", FlakyDb())
+    st.put("ns", "k", 1)
+    assert calls["n"] >= 3
+    monkeypatch.undo()
+    assert st.get("ns", "k") == 1
+
+
+def test_redis_retry_through_reconnect():
+    from tests.fake_redis import FakeRedis
+
+    from rmqtt_tpu.storage.redis import RedisStore
+
+    srv = FakeRedis()
+    try:
+        st = RedisStore(f"redis://127.0.0.1:{srv.port}/0")
+        base = FAILPOINTS.point("storage.write").triggers
+        FAILPOINTS.set("storage.write", "times(1, error)")
+        st.put("ns", "a", [1, 2])  # drop → reconnect → retry → lands
+        assert FAILPOINTS.point("storage.write").triggers - base == 1
+        FAILPOINTS.set("storage.read", "times(1, error)")
+        assert st.get("ns", "a") == [1, 2]
+        # exhaustion: a persistently-down redis surfaces ConnectionError
+        FAILPOINTS.set("storage.write", "error")
+        with pytest.raises(ConnectionError):
+            st.put("ns", "b", 1)
+        FAILPOINTS.clear_all()
+        assert st.get("ns", "b") is None
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------- cluster/bridge
+def test_cluster_forward_failpoint_only_hits_forward_frames():
+    from rmqtt_tpu.cluster.transport import PeerClient, PeerUnavailable
+
+    async def run():
+        peer = PeerClient(2, "127.0.0.1", 1)  # nothing listens on port 1
+        base = FAILPOINTS.point("cluster.forward").triggers
+        FAILPOINTS.set("cluster.forward", "error")
+        with pytest.raises(PeerUnavailable, match="cluster.forward"):
+            await peer.notify("forwards", {"x": 1})
+        assert FAILPOINTS.point("cluster.forward").triggers - base == 1
+        # non-forward frames skip the site (fail on the real connect)
+        with pytest.raises(PeerUnavailable, match="connect to node"):
+            await peer.notify("ping", {})
+        assert FAILPOINTS.point("cluster.forward").triggers - base == 1
+        await peer.close()
+
+    run_async(run)
+
+
+# ------------------------------------------------------------- HTTP surface
+def test_http_get_put_failpoints():
+    from tests.test_http_plugins import http_req
+
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.http_api import HttpApi
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        api = HttpApi(b.ctx, port=0)
+        await b.start()
+        await api.start()
+        try:
+            code, body = await http_req(api.bound_port, "GET", "/api/v1/failpoints")
+            assert code == 200
+            assert set(body["failpoints"]) >= {name for name, _ in SITES}
+            assert all(v["action"] == "off" for v in body["failpoints"].values())
+            code, body = await http_req(
+                api.bound_port, "PUT", "/api/v1/failpoints",
+                {"storage.read": "times(1, error)"})
+            assert code == 200
+            assert body["failpoints"]["storage.read"]["action"] == "times(1, error)"
+            assert FAILPOINTS.point("storage.read").spec == "times(1, error)"
+            # bad specs fail loudly (400), not silently
+            code, _ = await http_req(
+                api.bound_port, "PUT", "/api/v1/failpoints", {"storage.read": "nope"})
+            assert code == 400
+            code, _ = await http_req(
+                api.bound_port, "PUT", "/api/v1/failpoints", {"no.such": "error"})
+            assert code == 400
+            # disarm over HTTP
+            code, body = await http_req(
+                api.bound_port, "PUT", "/api/v1/failpoints", {"storage.read": "off"})
+            assert body["failpoints"]["storage.read"]["action"] == "off"
+            # the exposition carries per-site trigger counters
+            code, text = await http_req(
+                api.bound_port, "GET", "/metrics/prometheus", raw=True)
+            assert b"rmqtt_failpoint_triggers_total" in text
+        finally:
+            await api.stop()
+            await b.stop()
+
+    run_async(run)
+
+
+# -------------------------------------------------------- failover E2E plane
+def _device_ctx(**cfg):
+    """An xla-router context with every batch pinned to the DEVICE plane
+    (the trie mirror stays alive as the fallback)."""
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+
+    ctx = ServerContext(BrokerConfig(router="xla", **cfg))
+    r = ctx.router
+    r._hybrid_max = 0  # inline_ok() False: all batches go through dispatch
+    r._hybrid.small_max = 0
+    r._hybrid.probe_every = 0  # _pick() pinned to "device"
+    return ctx
+
+
+def test_failover_breaker_e2e_with_forced_reupload():
+    """device errors → breaker opens → host routing (zero lost) → fault
+    cleared → probe rewarns (FULL re-upload, not delta) + canaries →
+    breaker closes → device serves again."""
+
+    async def run():
+        from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+        ctx = _device_ctx(failover_cooldown=0.2, failover_threshold=2,
+                          failover_k_successes=2, route_cache=False)
+        fo = ctx.routing.failover
+        assert fo is not None and fo.usable
+        ctx.start()
+        try:
+            ctx.router.add("s/+/t", Id(1, "c1"), SubscriptionOptions(qos=1))
+            ctx.router.add("s/#", Id(1, "c2"), SubscriptionOptions(qos=0))
+            oracle = {"c1", "c2"}
+
+            def ids(relmap):
+                return {rel.id.client_id for rels in relmap.values() for rel in rels}
+
+            assert ids(await ctx.routing.matches(None, "s/a/t")) == oracle
+            br = fo.breaker
+            FAILPOINTS.set("device.dispatch", "error")
+            # every publish during the outage still resolves, correctly
+            for i in range(6):
+                assert ids(await ctx.routing.matches(None, f"s/b{i}/t")) == oracle
+            assert fo.active and br.state != br.CLOSED
+            assert fo.failures["dispatch_error"] >= 2
+            assert fo.host_items >= 4
+            st = ctx.routing.stats()
+            assert st["routing_failover_state"] in (1, 2)
+            assert st["routing_failovers"] == 1
+            # breaker registry surface: the device breaker is a named
+            # overload breaker like every other wrapped egress
+            assert ctx.overload.breakers["routing.device"] is br
+            full_before = ctx.router.matcher.full_uploads
+            FAILPOINTS.set("device.dispatch", "off")
+            t0 = time.time()
+            while fo.active and time.time() - t0 < 15:
+                await asyncio.sleep(0.05)
+            assert not fo.active, "no switchback after recovery"
+            assert br.state == br.CLOSED
+            assert fo.switchbacks == 1 and fo.probes >= 1
+            # the rewarm forced the FULL pack+upload path (delta gate shut)
+            assert ctx.router.matcher.full_uploads > full_before
+            assert ids(await ctx.routing.matches(None, "s/z/t")) == oracle
+            assert ctx.routing.stats()["routing_failover_state"] == 0
+        finally:
+            await ctx.stop()
+            FAILPOINTS.clear_all()
+
+    run_async(run, timeout=90.0)
+
+
+def test_failover_halfopen_failure_reopens():
+    """A probe against a still-faulty device re-opens the breaker with
+    backoff; traffic keeps flowing from the host the whole time."""
+
+    async def run():
+        from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+        ctx = _device_ctx(failover_cooldown=0.15, failover_threshold=1,
+                          route_cache=False)
+        fo = ctx.routing.failover
+        ctx.start()
+        try:
+            ctx.router.add("a/+", Id(1, "c1"), SubscriptionOptions(qos=0))
+            await ctx.routing.matches(None, "a/w")  # warm/JIT
+            FAILPOINTS.set("device.dispatch", "error")
+            await ctx.routing.matches(None, "a/1")
+            assert fo.active
+            t0 = time.time()
+            while fo.probes < 2 and time.time() - t0 < 10:
+                assert {1} == set(
+                    (await ctx.routing.matches(None, "a/x")).keys())
+                await asyncio.sleep(0.05)
+            assert fo.probes >= 2 and fo.probe_failures >= 1
+            assert fo.active  # fault still armed → still on the host plane
+            assert fo.breaker.state != fo.breaker.CLOSED
+        finally:
+            await ctx.stop()
+            FAILPOINTS.clear_all()
+
+    run_async(run, timeout=60.0)
+
+
+def test_device_timeout_watchdog_and_upload_classification():
+    """A hung completion is timed out by the watchdog (the batch is served
+    from the host, _complete_loop never wedges); an injected upload fault
+    is classified as upload_error."""
+
+    async def run():
+        from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+        ctx = _device_ctx(failover_cooldown=0.2, failover_threshold=1,
+                          failover_timeout_s=0.5, route_cache=False)
+        fo = ctx.routing.failover
+        ctx.start()
+        try:
+            ctx.router.add("a/+", Id(1, "c1"), SubscriptionOptions(qos=0))
+            await ctx.routing.matches(None, "a/w")  # warm/JIT past the deadline
+            FAILPOINTS.set("device.complete", "hang")
+            t0 = time.time()
+            res = await ctx.routing.matches(None, "a/1")
+            assert set(res.keys()) == {1}
+            assert time.time() - t0 < 5.0  # deadline, not a wedge
+            assert fo.failures["timeout"] >= 1 and fo.active
+            FAILPOINTS.set("device.complete", "off")
+            t0 = time.time()
+            while fo.active and time.time() - t0 < 15:
+                await asyncio.sleep(0.05)
+            assert not fo.active
+            # now fault the HBM refresh: classified as upload_error. A
+            # table mutation makes the next device batch refresh.
+            FAILPOINTS.set("device.upload", "error")
+            ctx.router.add("a/b/+", Id(1, "c2"), SubscriptionOptions(qos=0))
+            res = await ctx.routing.matches(None, "a/2")
+            assert set(res.keys()) == {1}
+            assert fo.failures["upload_error"] >= 1
+        finally:
+            await ctx.stop()
+            FAILPOINTS.clear_all()
+
+    run_async(run, timeout=90.0)
+
+
+def test_host_mirror_survives_hybrid_off(monkeypatch):
+    """RMQTT_HYBRID_MAX=0 (all-device routing, e.g. live soaks/benches)
+    must NOT drop the host trie mirror: it is the failover plane's
+    fallback table, needed most in exactly that regime. Pin that the
+    mirror is maintained and failover stays usable, while large batches
+    still route to the device (probe pinned off with the hybrid)."""
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+
+    monkeypatch.setenv("RMQTT_HYBRID_MAX", "0")
+    ctx = ServerContext(BrokerConfig(router="xla"))
+    r = ctx.router
+    assert r.host_available()
+    assert ctx.routing.failover is not None and ctx.routing.failover.usable
+    assert r._hybrid.small_max == 0 and r._hybrid.probe_every == 0
+
+
+def test_failover_disabled_keeps_seed_behavior():
+    """failover = false: no failover object; a device error with no
+    isolation recovery rejects only after split-and-retry proves every
+    item is poisoned (the _isolate path, satellite bugfix)."""
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+
+    ctx = ServerContext(BrokerConfig(router="xla", failover_enable=False))
+    assert ctx.routing.failover is None
+
+
+def test_poisoned_batch_isolates_single_item():
+    """One bad topic in a co-batched dispatch fails ONLY its own future
+    (split-and-retry, then per-item) — no failover plane involved."""
+
+    async def run():
+        from rmqtt_tpu.broker.routing import RoutingService
+
+        class PoisonRouter:
+            epochs_tracked = False
+            telemetry = None
+
+            def inline_ok(self, n):
+                return False
+
+            def matches_batch_raw(self, items):
+                out = []
+                for _fid, topic in items:
+                    if topic == "poison":
+                        raise ValueError("bad encode: poison")
+                    out.append({"ok": topic})
+                return out
+
+            def collapse(self, res):
+                return res
+
+        svc = RoutingService(PoisonRouter(), cache_enable=False)
+        svc.start()
+        try:
+            topics = ["t/1", "t/2", "poison", "t/3", "t/4"]
+            results = await asyncio.gather(
+                *(svc.matches(None, t) for t in topics),
+                return_exceptions=True)
+            assert results[0] == {"ok": "t/1"}
+            assert results[1] == {"ok": "t/2"}
+            assert isinstance(results[2], ValueError)
+            assert results[3] == {"ok": "t/3"}
+            assert results[4] == {"ok": "t/4"}
+        finally:
+            await svc.stop()
+
+    run_async(run)
+
+
+def test_isolate_bails_out_on_systemic_failure():
+    """_isolate's per-item pass is for item-shaped poison; when EVERY retry
+    fails (dead path, no usable failover) it must stop after the
+    consecutive-failure streak instead of issuing 2+N doomed calls that
+    back up the dispatch loop."""
+
+    async def run():
+        from rmqtt_tpu.broker.routing import RoutingService
+
+        calls = [0]
+
+        class DeadRouter:
+            epochs_tracked = False
+            telemetry = None
+
+            def inline_ok(self, n):
+                return False
+
+            def matches_batch_raw(self, items):
+                calls[0] += 1
+                raise RuntimeError("device is gone")
+
+            def collapse(self, res):
+                return res
+
+        svc = RoutingService(DeadRouter(), cache_enable=False)
+        svc.start()
+        try:
+            results = await asyncio.gather(
+                *(svc.matches(None, f"t/{i}") for i in range(16)),
+                return_exceptions=True)
+            assert all(isinstance(r, RuntimeError) for r in results)
+            # 1 original + 2 halves + at most streak per half of per-item
+            cap = 3 + 2 * RoutingService._ISOLATE_FAIL_STREAK
+            assert calls[0] <= cap, calls[0]
+        finally:
+            await svc.stop()
+
+    run_async(run)
+
+
+def test_inline_host_failure_does_not_trip_device_breaker():
+    """inline batches are host-served by contract: a failure there is
+    poison, not device evidence — the device breaker must stay closed and
+    only the failing item's future rejects."""
+
+    async def run():
+        ctx = _device_ctx(route_cache=False)
+        fo = ctx.routing.failover
+        r = ctx.router
+        real = r.matches_batch_raw
+
+        def flaky_inline(items):
+            if any(t == "poison" for _, t in items):
+                raise ValueError("bad encode: poison")
+            return real(items)
+
+        r.inline_ok = lambda n: True  # force the inline path
+        r.matches_batch_raw = flaky_inline
+        ctx.start()
+        try:
+            results = await asyncio.gather(
+                ctx.routing.matches(None, "a/b"),
+                ctx.routing.matches(None, "poison"),
+                ctx.routing.matches(None, "c/d"),
+                return_exceptions=True)
+            assert isinstance(results[1], ValueError)
+            assert not isinstance(results[0], Exception)
+            assert not isinstance(results[2], Exception)
+            assert fo.breaker.state == fo.breaker.CLOSED
+            assert not fo.active and fo.failure_total == 0
+        finally:
+            await ctx.stop()
+
+    run_async(run)
+
+
+def test_device_success_resets_breaker_on_sync_submit_path():
+    """Dense-path routers resolve device batches synchronously
+    (submit_batch_raw -> done=True): those successes must reset the
+    breaker's consecutive-failure count — sporadic transient errors spread
+    between millions of good batches must never open it. Trie-served sync
+    batches (last_match_was_device False) must NOT reset it."""
+
+    async def run():
+        from rmqtt_tpu.broker.failover import DeviceFailover
+        from rmqtt_tpu.broker.overload import CircuitBreaker
+        from rmqtt_tpu.broker.routing import RoutingService
+
+        class SyncDeviceRouter:
+            epochs_tracked = False
+            telemetry = None
+            fail_next = False
+            device_served = True
+
+            def inline_ok(self, n):
+                return False
+
+            def submit_batch_raw(self, items):
+                if self.fail_next:
+                    self.fail_next = False
+                    raise RuntimeError("transient XLA error")
+                return True, [{"ok": t} for _, t in items]
+
+            def last_match_was_device(self):
+                return self.device_served
+
+            def host_available(self):
+                return True
+
+            def host_inline_ok(self):
+                return True
+
+            def host_matches_batch_raw(self, items):
+                return [{"ok": t} for _, t in items]
+
+            def collapse(self, res):
+                return res
+
+        r = SyncDeviceRouter()
+        svc = RoutingService(r, cache_enable=False, pipeline_depth=2)
+        br = CircuitBreaker(threshold=3, cooldown=30.0)
+        svc.failover = DeviceFailover(r, br)
+        svc.start()
+        try:
+            # failure, success, failure, success, failure: consecutive
+            # count resets on each device success — breaker stays closed
+            for _ in range(3):
+                r.fail_next = True
+                await svc.matches(None, "a")  # served by host fallback
+                assert not svc.failover.active
+                await svc.matches(None, "b")  # device success -> reset
+            assert br.state == br.CLOSED and br.failures == 0
+            # same dance with trie-served successes: no reset, 3rd opens
+            r.device_served = False
+            for _ in range(3):
+                r.fail_next = True
+                await svc.matches(None, "a")
+                await svc.matches(None, "b")  # side-served: not evidence
+            assert br.state != br.CLOSED and svc.failover.active
+        finally:
+            await svc.stop()
+
+    run_async(run)
+
+
+def test_configure_is_all_or_nothing():
+    """A bad spec anywhere in a configure() batch (the HTTP PUT surface)
+    must arm NOTHING — a 400 can never leave earlier sites live."""
+    with pytest.raises(ValueError):
+        FAILPOINTS.configure({"device.dispatch": "error",
+                              "storage.write": "bogus("})
+    assert FAILPOINTS.point("device.dispatch").action is None
+    with pytest.raises(ValueError):
+        FAILPOINTS.configure({"storage.read": "error",
+                              "not.a.site": "error"})
+    assert FAILPOINTS.point("storage.read").action is None
+    FAILPOINTS.clear_all()
+
+
+def test_canary_topics_derive_from_live_filters():
+    """The probe's canary must compare NON-EMPTY device-vs-trie rows when
+    the table has routes (a static unmatched topic is a vacuous oracle):
+    topics derive from live filters with wildcards substituted, skipping
+    $-prefixed filters; empty table -> empty list (static fallback)."""
+    from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+    ctx = _device_ctx(route_cache=False)
+    r = ctx.router
+    assert r.canary_topics() == []
+    r.add("s/+/t", Id(1, "c1"), SubscriptionOptions(qos=0))
+    r.add("$sys/only", Id(1, "c2"), SubscriptionOptions(qos=0))
+    topics = r.canary_topics()
+    assert topics == ["s/canary/t"]
+    # the derived topic really matches its source filter in the trie oracle
+    assert len(r._side.match(topics[0])) == 1
+
+
+def test_probe_hang_does_not_strand_probing():
+    """A probe that hangs inside the device matcher (hung kernel during
+    rewarm/canary) must fail within the watchdog deadline and re-open the
+    breaker — never strand the broker in PROBING with _probe_task stuck."""
+
+    async def run():
+        ctx = _device_ctx(failover_cooldown=0.1, failover_threshold=1,
+                          failover_k_successes=1, failover_timeout_s=0.4,
+                          route_cache=False)
+        fo = ctx.routing.failover
+        ctx.start()
+        try:
+            from rmqtt_tpu.router.base import Id, SubscriptionOptions
+
+            ctx.router.add("p/#", Id(1, "c1"), SubscriptionOptions(qos=0))
+            await ctx.routing.matches(None, "p/x")  # warm the device path
+            FAILPOINTS.set("device.dispatch", "error")
+            # the faulted batch is still served (host fallback), breaker opens
+            await ctx.routing.matches(None, "p/x")
+            assert fo.active
+            # now every probe HANGS inside the device matcher
+            FAILPOINTS.set("device.dispatch", "hang")
+            deadline = time.time() + 5.0
+            while fo.probe_failures == 0 and time.time() < deadline:
+                await asyncio.sleep(0.05)
+            assert fo.probe_failures >= 1  # watchdog fired, probe counted failed
+            # heal: hang-blocked threads are abandoned; next probe recovers
+            FAILPOINTS.set("device.dispatch", "off")
+            deadline = time.time() + 10.0
+            while fo.active and time.time() < deadline:
+                await asyncio.sleep(0.05)
+            assert not fo.active and fo.switchbacks >= 1
+        finally:
+            FAILPOINTS.clear_all()
+            await ctx.stop()
+
+    run_async(run, timeout=60.0)
+
+
+def test_backoff_delays_bounded_schedule():
+    from rmqtt_tpu.broker.overload import backoff_delays
+
+    ds = list(backoff_delays(5, base=0.01, cap=0.05, jitter=0.0))
+    assert ds == [0.01, 0.02, 0.04, 0.05]  # capped, len == attempts-1
+    assert list(backoff_delays(1)) == []  # one attempt: no sleeps
+    r = random.Random(7)
+    jittered = list(backoff_delays(4, base=0.01, cap=1.0, jitter=0.5, rng=r))
+    assert all(0.01 * 2 ** i <= d <= 0.015 * 2 ** i for i, d in enumerate(jittered))
+
+
+# ------------------------------------------------------------- chaos matrix
+def test_chaos_matrix_fast_subset():
+    """Tier-1 wiring of scripts/chaos_matrix.py: the fast cells (one
+    device fault, one storage fault, one bridge fault — no hang/delay
+    cells) must produce an all-green JSON verdict."""
+    import importlib.util
+
+    path = pathlib.Path(__file__).parent.parent / "scripts" / "chaos_matrix.py"
+    spec = importlib.util.spec_from_file_location("chaos_matrix", path)
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+    verdict = asyncio.run(cm.run_matrix(cm.FAST_SUBSET))
+    assert verdict["ok"], verdict
+    assert set(verdict["cells"]) == set(cm.FAST_SUBSET)
+    # every matrix cell name refers to a real registered site
+    assert {n.split(":")[0] for n in cm.MATRIX} == {n for n, _ in SITES}
+
+
+def test_off_guard_micro_cost_pin():
+    """cfg7-style magnitude pin for the all-off hot path: the per-site
+    guard is ONE attribute load + is-test. 200K guarded iterations must
+    stay deep in the noise floor of any real dispatch (≤2µs/iter leaves
+    ~100x headroom over the observed cost on a busy shared core)."""
+    fp = FAILPOINTS.point("device.dispatch")
+    assert fp.action is None
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if fp.action is not None:
+            fp.fire_sync()
+    per_iter = (time.perf_counter() - t0) / n
+    assert per_iter < 2e-6, f"{per_iter * 1e9:.0f}ns per off-site check"

@@ -129,6 +129,87 @@ def test_chaos_abrupt_disconnects():
     run_async(run)
 
 
+def test_chaos_device_failpoint_failover_zero_loss():
+    """ISSUE-6 acceptance scenario: kill the device routing plane
+    mid-traffic (``device.dispatch = error``, then ``hang``) and prove —
+    against a filter-match oracle — that not one publish is lost or
+    misrouted while the broker fails over to the host trie, probes, force-
+    re-uploads and switches back."""
+
+    from rmqtt_tpu.core.topic import match_filter
+    from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, router="xla", route_cache=False,
+            failover_cooldown=0.3, failover_threshold=2,
+            failover_k_successes=2)))
+        # pin every batch to the DEVICE plane (the trie mirror stays as
+        # the fallback): this is the regime where device faults matter
+        r = b.ctx.router
+        r._hybrid_max = 0
+        r._hybrid.small_max = 0
+        r._hybrid.probe_every = 0
+        await b.start()
+        fo = b.ctx.routing.failover
+        assert fo is not None and fo.usable
+        try:
+            specs = {"fo-s0": "tele/+/temp", "fo-s1": "tele/#",
+                     "fo-s2": "tele/1/temp"}
+            subs = {}
+            for cid, filt in specs.items():
+                c = await TestClient.connect(b.port, cid)
+                await c.subscribe(filt, qos=1)
+                subs[cid] = c
+            pub = await TestClient.connect(b.port, "fo-pub")
+            sent = []
+
+            async def send(n, phase):
+                for i in range(n):
+                    topic = f"tele/{i % 3}/temp"
+                    payload = f"{phase}-{i}".encode()
+                    await pub.publish(topic, payload, qos=1)
+                    sent.append((topic, payload))
+
+            await send(10, "pre")  # healthy device plane (incl. JIT warm)
+            assert not fo.active
+            FAILPOINTS.set("device.dispatch", "error")
+            await send(15, "err")  # fails over mid-stream, host serves
+            assert fo.active and fo.failovers == 1
+            FAILPOINTS.set("device.dispatch", "hang")
+            await send(10, "hang")  # a probe may park on the hang; traffic flows
+            assert fo.active
+            FAILPOINTS.set("device.dispatch", "off")  # "unwedge the device"
+            deadline = asyncio.get_running_loop().time() + 30
+            while fo.active and asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+            assert not fo.active, "no switchback after the fault cleared"
+            assert fo.switchbacks == 1
+            await send(10, "post")  # back on the device plane
+            assert not fo.active
+
+            # oracle: per subscriber, the exact multiset of matching
+            # publishes — nothing lost, nothing misrouted, QoS1 end to end
+            for cid, filt in specs.items():
+                expect = {(t, p) for t, p in sent if match_filter(filt, t)}
+                got = set()
+                while len(got) < len(expect):
+                    p = await subs[cid].recv(timeout=10.0)
+                    got.add((p.topic, p.payload))
+                assert got == expect, cid
+                # and nothing EXTRA arrives (misroute would land here)
+                with pytest.raises(asyncio.TimeoutError):
+                    await subs[cid].recv(timeout=0.3)
+            assert fo.host_items >= 25  # err+hang phases rode the host plane
+            for c in [*subs.values(), pub]:
+                await c.close()
+        finally:
+            FAILPOINTS.clear_all()
+            await b.stop()
+
+    run_async(run, timeout=180.0)
+
+
 def test_chaos_broker_restart_recovery(tmp_path):
     """Kill the broker; restart; persistent state must recover
     (chaos/restart analogue, with session+retain storage)."""
