@@ -1,0 +1,10 @@
+"""Composable broker scenario harness (ROADMAP item 5).
+
+``rmqtt_tpu.bench.scenarios`` holds the phase primitives (connect storm,
+subscribe churn, fan-in/fan-out, overload burst, failpoint kills, durable
+QoS1/2 sessions), the named profiles assembled from them, and the shared
+``ScenarioReport`` JSON schema every bench/scenario entry point emits —
+`scripts/slo_matrix.py` is the CLI, and the legacy bench scripts
+(`soak_bench`, `throughput_bench`, `endurance_bench`) converge on the same
+report shape.
+"""

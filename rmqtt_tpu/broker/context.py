@@ -148,6 +148,20 @@ class BrokerConfig:
     overload_breaker_threshold: int = 5
     overload_breaker_cooldown: float = 3.0
     overload_breaker_max_cooldown: float = 30.0
+    # live SLO engine (broker/slo.py, [slo] config section): declarative
+    # latency/availability objectives over the telemetry histograms and
+    # reason-labeled drop counters, evaluated continuously into error
+    # budgets + multi-window burn rates (fast/slow). Observe-only (never
+    # touches the data plane); enable=false starts no task and samples
+    # nothing while /api/v1/slo stays shape-stable.
+    slo_enable: bool = True
+    slo_sample_interval: float = 5.0  # seconds between samples
+    slo_fast_window_s: float = 300.0  # fast burn window (cliff detector)
+    slo_slow_window_s: float = 3600.0  # slow burn window (budget keeper)
+    slo_burn_alert: float = 2.0  # fast burn rate that flags BURNING
+    # declarative objectives ([[slo.objectives]] rows); empty = built-in
+    # defaults (publish-e2e / connect latency + delivery availability)
+    slo_objectives: List[Dict[str, Any]] = field(default_factory=list)
     # device-plane failover (broker/failover.py, [routing] failover_* keys):
     # classified device-router failures trip a breaker; while open, publishes
     # route through the host trie mirror, half-open probes rewarm (full HBM
@@ -290,6 +304,13 @@ class ServerContext:
         from rmqtt_tpu.broker.overload import OverloadController
 
         self.overload = OverloadController(self, self.cfg)
+        # SLO engine (broker/slo.py): constructed unconditionally (like the
+        # overload controller) so /api/v1/slo, the gauges and $SYS are
+        # shape-stable; objective specs validate here, so a bad [slo]
+        # section fails at broker construction, not mid-flight
+        from rmqtt_tpu.broker.slo import SloEngine
+
+        self.slo = SloEngine(self, self.cfg)
         # failpoints ([failpoints] conf section, utils/failpoints.py):
         # applied here so broker configs reach the process registry; the
         # RMQTT_FAILPOINTS env string is re-applied on top (env outranks
@@ -357,8 +378,10 @@ class ServerContext:
         self.routing.start()
         self.delayed.start()
         self.overload.start()
+        self.slo.start()
 
     async def stop(self) -> None:
+        await self.slo.stop()
         await self.overload.stop()
         await self.routing.stop()
         await self.delayed.stop()
@@ -392,4 +415,12 @@ class ServerContext:
             1 for b in self.overload.breakers.values()
             if b.state != b.CLOSED
         )
+        # SLO gauges (broker/slo.py): worst objective state + transitions
+        s.slo_state = int(self.slo.worst_state)
+        s.slo_transitions = self.slo.transitions
+        # process RSS (utils/sysmon.py — same probe the overload sampler
+        # uses); sums to a cluster memory total in /stats/sum
+        from rmqtt_tpu.utils.sysmon import rss_mb
+
+        s.rss_mb = rss_mb()
         return s

@@ -46,6 +46,10 @@ class HookType(enum.Enum):
     # overload-controller state change (broker/overload.py): fired with
     # (old_state_name, new_state_name, snapshot) on every transition
     SERVER_OVERLOAD = "server_overload"
+    # SLO-engine objective state change (broker/slo.py): fired with
+    # (objective_name, old_state_name, new_state_name, objective_row) on
+    # every burn/exhaustion transition
+    SERVER_SLO = "server_slo"
 
 
 @dataclass

@@ -35,14 +35,11 @@ def sysinfo() -> dict:
         out["load1"], out["load5"], out["load15"] = round(l1, 2), round(l5, 2), round(l15, 2)
     except (OSError, AttributeError):  # AttributeError: not on Windows
         pass
-    try:
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    out["memory_rss_kb"] = int(line.split()[1])
-                    break
-    except OSError:
-        pass
+    from rmqtt_tpu.utils.sysmon import rss_mb
+
+    mb = rss_mb()
+    if mb:
+        out["memory_rss_kb"] = int(mb * 1024)
     out["cpus"] = os.cpu_count()
     return out
 
@@ -234,6 +231,7 @@ class HttpApi:
                 "/api/v1/stats", "/api/v1/stats/sum",
                 "/api/v1/metrics", "/api/v1/metrics/sum",
                 "/api/v1/latency", "/api/v1/latency/sum",
+                "/api/v1/slo", "/api/v1/slo/sum",
                 "/api/v1/overload",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
                 "/api/v1/traces", "/api/v1/traces/slow",
@@ -403,6 +401,22 @@ class HttpApi:
             # stage histograms + slow-op ring (broker/telemetry.py);
             # shape-stable with telemetry disabled (zero-count stages)
             return 200, {"node": ctx.node_id, **ctx.telemetry.snapshot()}, J
+        if path == "/api/v1/slo/sum":
+            # cluster-wide SLO: per-objective (good, total) pairs sum
+            # across nodes (cumulative + both windows), burn rates
+            # recomputed from the merged sums, states merged by worst
+            from rmqtt_tpu.broker.slo import SloEngine
+
+            local = ctx.slo.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "slo"},
+                lambda r: [r["slo"]] if "slo" in r else [],
+            )
+            return 200, SloEngine.merge_snapshots(local, peers), J
+        if path == "/api/v1/slo":
+            # live error budgets + burn rates (broker/slo.py); shape-stable
+            # with the engine disabled (objectives listed, zero data)
+            return 200, {"node": ctx.node_id, **ctx.slo.snapshot()}, J
         if path == "/api/v1/overload":
             # overload-controller state (broker/overload.py): watermark
             # state + signals, admission counters, shed totals, breakers;
@@ -608,6 +622,8 @@ class HttpApi:
                 f'site="{site}"}} {snap["triggers"]}')
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
+        # SLO gauges + good/bad event counters (broker/slo.py)
+        lines.extend(self.ctx.slo.prometheus_lines(labels))
         # tracing counters + span-store gauge (broker/tracing.py)
         lines.extend(self.ctx.tracer.prometheus_lines(labels))
         return "\n".join(lines) + "\n"
@@ -629,6 +645,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 </style></head><body>
 <h1>rmqtt_tpu broker <span id="node"></span></h1><div id="err"></div>
 <div class="cards" id="stats"></div>
+<h2>SLO</h2><div class="cards" id="slo"></div>
 <h2>Overload</h2><div class="cards" id="overload"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
@@ -647,7 +664,7 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_upload_bytes","routing_compactions","routing_compact_ms_total",
  "routing_cand_cache_invalidations","routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
- "routing_device_failures"];
+ "routing_device_failures","slo_state","slo_transitions","rss_mb"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
 // histogram units are ns, rendered as ms)
 const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],
@@ -674,6 +691,12 @@ async function tick(){
   const subs=await j("/api/v1/subscriptions?_limit=50");
   document.querySelector("#subs tbody").innerHTML=subs.map(s=>
    `<tr><td>${esc(s.client_id)}</td><td>${esc(s.topic_filter)}</td><td>${esc(s.qos)}</td></tr>`).join("");
+  const slo=await j("/api/v1/slo");
+  document.getElementById("slo").innerHTML=
+   `<div class="card"><div class="v"${slo.state_value?' style="color:#b00020"':''}>${esc(slo.state)}</div><div class="k">slo${slo.enabled?"":" (disabled)"}</div></div>`+
+   (slo.objectives||[]).map(o=>
+    `<div class="card"><div class="v"${o.state_value?' style="color:#b00020"':''}>${esc((o.budget_remaining*100).toFixed(1))}%</div>
+     <div class="k">${esc(o.name)} budget (burn ${esc(o.fast.burn_rate)}/${esc(o.slow.burn_rate)})</div></div>`).join("");
   const ov=await j("/api/v1/overload");
   const shed=ov.shed||{},adm=ov.admission||{},brks=ov.breakers||{};
   document.getElementById("overload").innerHTML=

@@ -96,6 +96,15 @@ class Stats:
         self.overload_state = 0
         self.overload_transitions = 0
         self.overload_open_breakers = 0
+        # SLO-engine gauges (broker/slo.py), overwritten by
+        # ServerContext.stats(). state is the WORST objective's state:
+        # 0=OK 1=BURNING (fast-window burn over the alert rate)
+        # 2=EXHAUSTED (slow-window error budget fully spent)
+        self.slo_state = 0
+        self.slo_transitions = 0
+        # process resident set (utils/sysmon.py); a plain sum-mode float so
+        # /stats/sum reports cluster-total memory
+        self.rss_mb = 0.0
         # device-plane failover gauges (broker/failover.py), overwritten
         # from RoutingService.stats(); zeros for routers without a host
         # fallback. state is 0=device (healthy) 1=host fallback 2=probing

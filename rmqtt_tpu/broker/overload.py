@@ -54,6 +54,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from rmqtt_tpu.utils.sysmon import rss_mb
+
 log = logging.getLogger("rmqtt_tpu.overload")
 
 
@@ -382,7 +384,7 @@ class OverloadController:
             "routing_queue": ctx.routing.queue_fraction(),
             "mqueue": mq_len / mq_cap if mq_cap else 0.0,
             "inflight": infl_len / infl_cap if infl_cap else 0.0,
-            "rss_mb": _rss_mb(),
+            "rss_mb": rss_mb(),
             "connect_rate": ctx.handshake_rate.rate(),
         }
         self.last_signals = {k: round(v, 4) for k, v in sig.items()}
@@ -558,15 +560,3 @@ class OverloadController:
             },
             "breakers": {name: b.snapshot() for name, b in self.breakers.items()},
         }
-
-
-def _rss_mb() -> float:
-    """Process resident set in MB (0.0 where /proc is unavailable)."""
-    try:
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    return int(line.split()[1]) / 1024.0
-    except OSError:
-        pass
-    return 0.0

@@ -210,6 +210,10 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             # per-node latency histograms for /api/v1/latency/sum; buckets
             # merge by addition on the requesting node
             return {"latency": ctx.telemetry.snapshot()}
+        if what == "slo":
+            # per-node SLO snapshot for /api/v1/slo/sum; (good, total)
+            # pairs sum per objective on the requesting node
+            return {"slo": ctx.slo.snapshot()}
         if what == "traces":
             # trace-API cluster fetch (broker/tracing.py): by id → this
             # node's spans for that trace (the requester stitches);

@@ -346,6 +346,29 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "trace_max_traces": ("trace_max_traces", int),
         "trace_max_spans": ("trace_max_spans", int),
     }, broker_kwargs)
+    # [slo] — the live SLO engine (broker/slo.py): error budgets +
+    # multi-window burn rates over the telemetry histograms and drop
+    # counters. ``objectives`` is an array-of-tables ([[slo.objectives]])
+    # of declarative objective rows, validated when the engine is
+    # constructed; the scalar knobs map like every other flat section.
+    slo_tree = tree.get("slo")
+    if slo_tree is not None:
+        slo_tree = dict(slo_tree)
+        objectives = slo_tree.pop("objectives", None)
+        if objectives is not None:
+            if not isinstance(objectives, list) or not all(
+                isinstance(o, dict) for o in objectives
+            ):
+                raise ValueError(
+                    "[[slo.objectives]] must be an array of tables")
+            broker_kwargs["slo_objectives"] = [dict(o) for o in objectives]
+        _apply_section({"slo": slo_tree}, "slo", {
+            "enable": ("slo_enable", bool),
+            "sample_interval": ("slo_sample_interval", float),
+            "fast_window_s": ("slo_fast_window_s", float),
+            "slow_window_s": ("slo_slow_window_s", float),
+            "burn_alert": ("slo_burn_alert", float),
+        }, broker_kwargs)
     # [overload] — the overload-control subsystem (broker/overload.py):
     # watermark states + admission buckets + degradation tiers + breakers
     _apply_section(tree, "overload", {

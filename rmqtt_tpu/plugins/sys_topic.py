@@ -118,6 +118,7 @@ class SysTopicPlugin(Plugin):
                 )
                 await self._publish_latency()
                 await self._publish_tracing()
+            await self._publish_slo()
             await self._publish_overload()
             await self._publish_failover()
             await asyncio.sleep(self.interval)
@@ -144,6 +145,27 @@ class SysTopicPlugin(Plugin):
             await self._publish(
                 f"{self._prefix}/latency/slow_ops",
                 json.dumps(snap["slow_ops"]).encode(),
+            )
+
+    async def _publish_slo(self) -> None:
+        """$SYS/brokers/<node>/slo/#: ``slo/state`` carries the worst
+        state + windows, ``slo/objectives/<name>`` one row per objective
+        (budget remaining, fast/slow burn rates). Like the overload tree,
+        published only while the engine is enabled — and kept publishing
+        at ELEVATED (budget burn is exactly what an operator needs then),
+        which is why this sits outside the ``allow_sys`` gate."""
+        slo = getattr(self.ctx, "slo", None)
+        if slo is None or not slo.enabled:
+            return
+        snap = slo.snapshot()
+        objectives = snap.pop("objectives", [])
+        await self._publish(
+            f"{self._prefix}/slo/state", json.dumps(snap).encode()
+        )
+        for row in objectives:
+            await self._publish(
+                f"{self._prefix}/slo/objectives/{row['name']}",
+                json.dumps(row).encode(),
             )
 
     async def _publish_overload(self) -> None:

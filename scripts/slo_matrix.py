@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""SLO scenario matrix: named mixed-phase profiles → one ScenarioReport.
+
+The composable successor to the single-axis bench scripts (ROADMAP item
+5): each profile assembles phase primitives (connect storm, subscribe
+churn, fan-in/fan-out, overload burst, failpoint-driven device kill,
+durable QoS1/2 persistent sessions) from ``rmqtt_tpu/bench/scenarios.py``
+against a real broker subprocess, and emits ONE JSON report — goodput,
+broker-side per-stage p50/p99 (from `/api/v1/latency`), reason-labeled
+drop deltas, RSS, live burn-rate samples, and per-objective SLO verdicts
+from the broker's own SLO engine (`/api/v1/slo`).
+
+Exit code 0 iff every selected profile's report is ``ok`` — so CI (and
+future PRs) gate on "p99 < X under profile Y" instead of single numbers.
+
+Usage:
+  python scripts/slo_matrix.py --list
+  python scripts/slo_matrix.py --profile storm_churn_overload_kill
+  python scripts/slo_matrix.py --all --out slo_matrix.json
+
+The ``smoke_fast`` profile (seconds, storm+churn+shed with the verdict
+asserted) runs in tier-1 via tests/test_slo.py so the harness itself
+can't rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.bench import scenarios  # noqa: E402
+
+
+async def run_many(names) -> dict:
+    reports = {}
+    for name in names:
+        t0 = time.time()
+        try:
+            rep = await scenarios.run_profile_async(name)
+        except Exception as e:  # a crashed profile is a failed profile
+            rep = scenarios.finish_report(
+                scenarios.base_report(name), ok=False)
+            rep["errors"].append(f"{type(e).__name__}: {e}")
+        reports[name] = rep
+        verdict = "PASS" if rep["ok"] else "FAIL"
+        slo = rep.get("slo") or {}
+        objs = ", ".join(
+            f"{o['name']}={'ok' if o['compliant'] else 'VIOLATED'}"
+            for o in slo.get("objectives", ()))
+        print(f"[{verdict}] {name} ({round(time.time() - t0, 1)}s) "
+              f"goodput={rep.get('goodput', {}).get('delivered_per_s')}"
+              f"/s slo: {objs or 'n/a'}", flush=True)
+    return {
+        "schema": scenarios.SCHEMA,
+        "ok": all(r["ok"] for r in reports.values()),
+        "profiles": reports,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--profile", action="append", default=[],
+                    help="profile name (repeatable)")
+    ap.add_argument("--all", action="store_true", help="run every profile")
+    ap.add_argument("--list", action="store_true",
+                    help="list profiles and exit")
+    ap.add_argument("--out", default="slo_matrix.json")
+    args = ap.parse_args()
+    if args.list:
+        for name, p in scenarios.PROFILES.items():
+            phases = ", ".join(
+                pname for step in p.steps for pname, _, _ in step)
+            print(f"{name:28s} {p.descr}\n{'':28s} phases: {phases}")
+        return 0
+    names = list(scenarios.PROFILES) if args.all else (
+        args.profile or scenarios.FAST_SUBSET)
+    unknown = [n for n in names if n not in scenarios.PROFILES]
+    if unknown:
+        ap.error(f"unknown profile(s) {unknown}; --list shows the matrix")
+    verdict = asyncio.run(run_many(names))
+    Path(args.out).write_text(json.dumps(verdict, indent=2) + "\n")
+    print(f"matrix -> {args.out} (ok={verdict['ok']})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,7 +30,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from rmqtt_tpu.bench import scenarios  # noqa: E402
 from rmqtt_tpu.broker.codec import MqttCodec, packets as pk  # noqa: E402
+from rmqtt_tpu.utils.sysmon import rss_mb  # noqa: E402
 
 FD_HEADROOM = 1024  # fds the process needs beyond its MQTT connections
 
@@ -39,17 +41,6 @@ def nofile_limit() -> int:
     import resource
 
     return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
-
-
-def rss_mb(pid: int) -> float:
-    try:
-        with open(f"/proc/{pid}/status") as f:
-            for line in f:
-                if line.startswith("VmRSS"):
-                    return int(line.split()[1]) / 1024.0
-    except OSError:
-        pass
-    return 0.0
 
 
 def broker_worker_pids(parent_pid: int) -> list:
@@ -119,6 +110,8 @@ async def shard_main(args) -> None:
         fails += n - len(ok)
         conns.extend(ok)
     dt = time.perf_counter() - t0
+    # internal parent←shard IPC line, not output: the parent aggregates
+    # these into the shared ScenarioReport (rmqtt_tpu/bench/scenarios.py)
     print(json.dumps({"established": len(conns), "secs": round(dt, 2),
                       "failures": fails}), flush=True)
     # keep them open until the parent closes stdin
@@ -163,7 +156,8 @@ async def liveness_check(port: int, cid: str = "soak-live",
                 break
         ms = (time.perf_counter() - t0) * 1000
         if not quiet:
-            print(f"pub->sub delivery at full load: {ms:.1f} ms")
+            print(f"pub->sub delivery at full load: {ms:.1f} ms",
+                  file=sys.stderr)
         return ms
     finally:
         for w in (sw, pw):
@@ -174,7 +168,7 @@ async def liveness_check(port: int, cid: str = "soak-live",
                     pass
 
 
-async def main() -> None:
+async def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--conns", type=int, default=10_000)
     ap.add_argument("--broker-port", type=int, default=18900)
@@ -206,12 +200,19 @@ async def main() -> None:
                          "scatter-gather, O(workers) RPCs per handshake) is "
                          "excluded, and so is cross-worker routing — use the "
                          "default clustered mode to measure THAT")
+    ap.add_argument("--out", default="soak_report.json",
+                    help="ScenarioReport JSON destination")
     ap.add_argument("--shard-id", type=int, default=None,
                     help=argparse.SUPPRESS)  # internal: run as a shard child
     args = ap.parse_args()
     if args.shard_id is not None:
         await shard_main(args)
         return
+    # the shared ScenarioReport (rmqtt_tpu/bench/scenarios.py) replaces
+    # this script's old print-only output; the prints stay as narration
+    report = scenarios.base_report("connection_soak")
+    report["descr"] = (f"{args.conns} held connections, "
+                       f"{'flat' if args.flat_workers else 'clustered'} mode")
 
     limit = nofile_limit()
     per_side = limit - FD_HEADROOM
@@ -219,7 +220,7 @@ async def main() -> None:
     need_workers = max(args.workers, (args.conns + per_side - 1) // per_side)
     if need_shards != args.procs or need_workers != args.workers:
         print(f"fd cap {limit}/proc: using --procs {need_shards} "
-              f"--workers {need_workers}")
+              f"--workers {need_workers}", file=sys.stderr)
     repo = Path(__file__).resolve().parent.parent
 
     flat_procs = []
@@ -259,7 +260,9 @@ async def main() -> None:
         bpids = ([p.pid for p in flat_procs] if flat_procs
                  else broker_worker_pids(proc.pid))
         base_rss = sum(rss_mb(p) for p in bpids)
-        print(f"broker pids {bpids}, baseline RSS {base_rss:.1f} MB")
+        print(f"broker pids {bpids}, baseline RSS {base_rss:.1f} MB",
+              file=sys.stderr)
+        report["rss_mb"]["start"] = round(base_rss, 1)
 
         per = [args.conns // need_shards] * need_shards
         per[0] += args.conns - sum(per)
@@ -286,12 +289,22 @@ async def main() -> None:
         dt = time.perf_counter() - t0
         print(f"established {established} connections in {dt:.1f}s wall "
               f"({established / dt:.0f} handshakes/s aggregate, "
-              f"{failures} dial failures after retries)")
+              f"{failures} dial failures after retries)", file=sys.stderr)
+        report["phases"].append({
+            "name": "connect_storm", "ok": established >= args.conns * 0.99,
+            "established": established, "failures": failures,
+            "seconds": round(dt, 2),
+            "handshakes_per_s": round(established / dt, 1),
+        })
         bpids = ([p.pid for p in flat_procs] if flat_procs
                  else broker_worker_pids(proc.pid))
         full_rss = sum(rss_mb(p) for p in bpids)
         print(f"broker RSS at {established} conns: {full_rss:.1f} MB total "
-              f"({(full_rss - base_rss) * 1024 / max(1, established):.1f} KB/conn)")
+              f"({(full_rss - base_rss) * 1024 / max(1, established):.1f} KB/conn)",
+              file=sys.stderr)
+        report["rss_mb"]["end"] = round(full_rss, 1)
+        report["rss_mb"]["kb_per_conn"] = round(
+            (full_rss - base_rss) * 1024 / max(1, established), 1)
 
         if flat_procs:
             # idle CPU at full load (the reference's 1-200% @1M row): sum
@@ -309,7 +322,12 @@ async def main() -> None:
             time.sleep(30)
             dj = cpu_jiffies() - j0
             print(f"broker idle CPU at {established} conns: "
-                  f"{dj / 30:.1f}% of one core (sum of workers, 30s window)")
+                  f"{dj / 30:.1f}% of one core (sum of workers, 30s window)",
+                  file=sys.stderr)
+            report["phases"].append({
+                "name": "idle_hold", "ok": True, "seconds": 30.0,
+                "idle_cpu_pct_of_core": round(dj / 30, 1),
+            })
             # SO_REUSEPORT spreads connections; a pub/sub pair only sees
             # each other on the same worker. Race a worker-count's worth of
             # pairs CONCURRENTLY per round (expected ~1 collision/round)
@@ -330,17 +348,33 @@ async def main() -> None:
             if hit is not None:
                 print(f"pub->sub delivery at full load: {hit:.1f} ms "
                       f"(same-worker pair; cross-worker routing needs the "
-                      f"clustered mode)")
+                      f"clustered mode)", file=sys.stderr)
+                report["phases"].append({
+                    "name": "liveness", "ok": True,
+                    "delivery_ms": round(hit, 1)})
             else:
                 print("  no same-worker pub/sub pair found (flat mode has "
-                      "no cross-worker routing)")
+                      "no cross-worker routing)", file=sys.stderr)
+                report["phases"].append({"name": "liveness", "ok": False,
+                                         "delivery_ms": None})
         else:
-            await liveness_check(args.broker_port)
+            ms = await liveness_check(args.broker_port)
+            report["phases"].append({"name": "liveness", "ok": True,
+                                     "delivery_ms": round(ms, 1)})
 
         for sh in shards:
             sh.stdin.close()
         for sh in shards:
             sh.wait(timeout=60)
+        report["goodput"] = {
+            "established": established,
+            "handshakes_per_s": round(established / dt, 1),
+            "dial_failures": failures,
+        }
+        scenarios.finish_report(
+            report, all(p["ok"] for p in report["phases"]))
+        scenarios.write_report(report, args.out)
+        return 0 if report["ok"] else 1
     finally:
         for p in (flat_procs or [proc]):
             p.send_signal(signal.SIGTERM)
@@ -352,4 +386,6 @@ async def main() -> None:
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    # exit code follows report["ok"] like the other ScenarioReport
+    # emitters, so CI can gate on the soak
+    raise SystemExit(asyncio.run(main()))

@@ -1,216 +1,63 @@
 #!/usr/bin/env python
-"""End-to-end broker message throughput: raw-socket publishers/subscribers
-against a real broker process (BASELINE.md context: the reference reports
-~150K msg/s on 4 cores; this host is 1 core shared between broker AND the
-bench clients, so figures here are a floor for per-core throughput).
+"""End-to-end broker message throughput — now a thin wrapper over the
+scenario runner (`rmqtt_tpu/bench/scenarios.py`, ROADMAP item 5).
 
-Scenarios: 1→1 pipe, 1→N fan-out, N→1 fan-in (all QoS0 — the throughput
-path; QoS1 adds one ack per message on the same machinery).
+Scenarios: 1→1 QoS0 pipe, delivery-paced QoS1 pipe, 1→N fan-out, N→1
+fan-in — the same shapes this script always drove (BASELINE.md context:
+the reference reports ~150K msg/s on 4 cores; this host is 1 shared
+core, so figures are a per-core floor), but the output is one shared
+``ScenarioReport`` (goodput, broker-side stage p50/p99 from
+`/api/v1/latency`, drop reasons, RSS, SLO verdicts) instead of ad-hoc
+prints, and the exit code follows the SLO verdict.
 
-Usage: python scripts/throughput_bench.py [--msgs 20000] [--port 18910]
+Usage: python scripts/throughput_bench.py [--msgs 20000] [--out FILE]
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
-import socket
-import subprocess
+import dataclasses
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from rmqtt_tpu.broker.codec import MqttCodec, packets as pk  # noqa: E402
+from rmqtt_tpu.bench import scenarios  # noqa: E402
 
 
-async def _read_until(reader, codec, ptype):
-    while True:
-        data = await reader.read(4096)
-        if not data:
-            raise ConnectionError(f"peer closed before {ptype.__name__}")
-        for p in codec.feed(data):
-            if isinstance(p, ptype):
-                return p
+def scaled_profile(msgs: int) -> scenarios.Profile:
+    """The registered throughput_suite with its volumes scaled to
+    ``--msgs`` (the suite's per-phase defaults assume 20K)."""
+    base = scenarios.PROFILES["throughput_suite"]
+    scale = msgs / 20_000
+    steps = []
+    for step in base.steps:
+        scaled = []
+        for name, fn, params in step:
+            params = dict(params)
+            for key in ("msgs", "msgs_per"):
+                if key in params:
+                    params[key] = max(50, int(params[key] * scale))
+            scaled.append((name, fn, params))
+        steps.append(tuple(scaled))
+    return dataclasses.replace(base, steps=tuple(steps))
 
 
-async def connect(port, cid):
-    reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    codec = MqttCodec()
-    writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
-    await writer.drain()
-    await _read_until(reader, codec, pk.Connack)
-    return reader, writer, codec
-
-
-async def subscribe(conn, tf, qos=0):
-    reader, writer, codec = conn
-    writer.write(codec.encode(pk.Subscribe(1, [(tf, pk.SubOpts(qos=qos))])))
-    await writer.drain()
-    await _read_until(reader, codec, pk.Suback)
-
-
-async def drain_publishes(conn, want, deadline):
-    reader, _w, codec = conn
-    got = 0
-    while got < want:
-        data = await asyncio.wait_for(reader.read(1 << 16), deadline - time.monotonic())
-        if not data:
-            raise ConnectionError("subscriber closed")
-        got += sum(1 for p in codec.feed(data) if isinstance(p, pk.Publish))
-    return got
-
-
-async def blast(conn, topic, n, payload=b"x" * 64):
-    _r, writer, codec = conn
-    frame = codec.encode(pk.Publish(topic=topic, payload=payload, qos=0))
-    # batch writes so the bench client isn't the syscall bottleneck
-    batch = frame * 64
-    full, rest = divmod(n, 64)
-    for _ in range(full):
-        writer.write(batch)
-        if writer.transport.get_write_buffer_size() > 1 << 20:
-            await writer.drain()
-    writer.write(frame * rest)
-    await writer.drain()
-
-
-async def scenario_pipe(port, msgs):
-    sub = await connect(port, "tp-sub")
-    await subscribe(sub, "tp/pipe")
-    pub = await connect(port, "tp-pub")
-    t0 = time.monotonic()
-    deadline = t0 + 120
-    task = asyncio.create_task(drain_publishes(sub, msgs, deadline))
-    await blast(pub, "tp/pipe", msgs)
-    await task
-    dt = time.monotonic() - t0
-    print(f"1->1 pipe:    {msgs} msgs in {dt:.2f}s = {msgs / dt:,.0f} msg/s")
-
-
-async def scenario_pipe_qos1(port, msgs):
-    """QoS1 pipe: publisher paced by DELIVERIES (stays under the broker's
-    bounded deliver queue, so nothing is policy-dropped) and every hop is
-    acked — the lossless end-to-end figure."""
-    sub = await connect(port, "tp1-sub")
-    reader, writer, codec = sub
-    await subscribe(sub, "tp1/pipe", qos=1)
-    pub = await connect(port, "tp1-pub")
-    pr, pw, pc = pub
-    t0 = time.monotonic()
-    deadline = t0 + 180
-    state = {"sent": 0, "got": 0}
-
-    async def drain_and_ack():
-        while state["got"] < msgs:
-            data = await asyncio.wait_for(reader.read(1 << 16), deadline - time.monotonic())
-            if not data:
-                raise ConnectionError("subscriber closed")
-            acks = bytearray()
-            for p in codec.feed(data):
-                if isinstance(p, pk.Publish):
-                    state["got"] += 1
-                    if p.packet_id is not None:
-                        acks += codec.encode(pk.Puback(p.packet_id))
-            if acks:
-                writer.write(bytes(acks))
-                await writer.drain()
-
-    async def drain_pubacks():
-        while state["got"] < msgs:
-            try:
-                data = await asyncio.wait_for(pr.read(1 << 16), 1.0)
-            except asyncio.TimeoutError:
-                continue
-            pc.feed(data)  # count-free: pacing rides deliveries
-
-    async def sender():
-        while state["sent"] < msgs:
-            if state["sent"] - state["got"] >= 500:  # < broker mqueue (1000)
-                await asyncio.sleep(0.002)
-                continue
-            burst = bytearray()
-            for _ in range(min(64, msgs - state["sent"])):
-                state["sent"] += 1
-                burst += pc.encode(pk.Publish(topic="tp1/pipe", payload=b"x" * 64,
-                                              qos=1, packet_id=(state["sent"] % 65000) + 1))
-            pw.write(bytes(burst))
-            await pw.drain()
-
-    drainer = asyncio.create_task(drain_pubacks())
-    send_task = asyncio.create_task(sender())
-    try:
-        await asyncio.gather(drain_and_ack(), send_task)
-    finally:
-        for t in (drainer, send_task):
-            t.cancel()
-    dt = time.monotonic() - t0
-    print(f"1->1 qos1:    {msgs} delivered+acked msgs in {dt:.2f}s = {msgs / dt:,.0f} msg/s")
-
-
-async def scenario_fanout(port, msgs, nsubs=50):
-    subs = []
-    for i in range(nsubs):
-        c = await connect(port, f"tp-fo-{i}")
-        await subscribe(c, "tp/fanout")
-        subs.append(c)
-    pub = await connect(port, "tp-fo-pub")
-    per_pub = msgs // nsubs
-    t0 = time.monotonic()
-    deadline = t0 + 120
-    tasks = [asyncio.create_task(drain_publishes(c, per_pub, deadline)) for c in subs]
-    await blast(pub, "tp/fanout", per_pub)
-    await asyncio.gather(*tasks)
-    dt = time.monotonic() - t0
-    delivered = per_pub * nsubs
-    print(f"1->{nsubs} fanout: {per_pub} pubs -> {delivered} deliveries in {dt:.2f}s "
-          f"= {delivered / dt:,.0f} deliveries/s")
-
-
-async def scenario_fanin(port, msgs, npubs=50):
-    sub = await connect(port, "tp-fi-sub")
-    await subscribe(sub, "tp/fanin/#")
-    pubs = [await connect(port, f"tp-fi-{i}") for i in range(npubs)]
-    per_pub = msgs // npubs
-    t0 = time.monotonic()
-    deadline = t0 + 120
-    task = asyncio.create_task(drain_publishes(sub, per_pub * npubs, deadline))
-    await asyncio.gather(*(blast(p, f"tp/fanin/{i}", per_pub) for i, p in enumerate(pubs)))
-    await task
-    dt = time.monotonic() - t0
-    print(f"{npubs}->1 fanin:  {per_pub * npubs} msgs in {dt:.2f}s = {per_pub * npubs / dt:,.0f} msg/s")
-
-
-async def main():
-    ap = argparse.ArgumentParser()
+async def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--msgs", type=int, default=20_000)
-    ap.add_argument("--port", type=int, default=18910)
+    ap.add_argument("--out", default="throughput_report.json")
     args = ap.parse_args()
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(args.port)],
-        cwd=str(Path(__file__).resolve().parent.parent),
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-    )
-    try:
-        for _ in range(100):
-            if proc.poll() is not None:
-                raise RuntimeError(f"broker exited rc={proc.returncode} before listening")
-            try:
-                with socket.create_connection(("127.0.0.1", args.port), timeout=0.3):
-                    break
-            except OSError:
-                time.sleep(0.1)
-        else:
-            raise RuntimeError("broker never started listening")
-        await scenario_pipe(args.port, args.msgs)
-        await scenario_pipe_qos1(args.port, args.msgs)
-        await scenario_fanout(args.port, args.msgs)
-        await scenario_fanin(args.port, args.msgs)
-    finally:
-        proc.terminate()
-        proc.wait(timeout=15)
+    report = await scenarios.run_profile_async(scaled_profile(args.msgs))
+    for row in report["phases"]:
+        rate = row.get("msgs_per_s") or row.get("deliveries_per_s") or 0
+        print(f"{row['name']:12s} {row.get('delivered', 0):>7} delivered "
+              f"in {row.get('seconds', 0):6.2f}s = {rate:,.0f}/s "
+              f"[{'ok' if row.get('ok') else 'FAIL'}]", file=sys.stderr)
+    scenarios.write_report(report, args.out)
+    return 0 if report["ok"] else 1
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    raise SystemExit(asyncio.run(main()))

@@ -57,11 +57,16 @@ class TestClient:
         properties: Optional[dict] = None,
         host: str = "127.0.0.1",
         auth_handler=None,
+        auto_ack: bool = True,
     ) -> "TestClient":
         reader, writer = await asyncio.open_connection(host, port)
         codec = MqttCodec(version)
         client = cls(reader, writer, codec, version)
         client.auth_handler = auth_handler
+        # must be applied BEFORE the read loop starts: a resumed session's
+        # queued deliveries arrive the moment the CONNACK lands, racing any
+        # post-connect `client.auto_ack = False` assignment
+        client.auto_ack = auto_ack
         writer.write(
             codec.encode(
                 pk.Connect(

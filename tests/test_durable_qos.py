@@ -1,0 +1,175 @@
+"""Durable-path correctness: QoS1/2 through sqlite message storage into
+persistent sessions, with session takeover + in-flight resend under
+concurrent load — the correctness twin of the ``durable_qos12`` scenario
+profile (rmqtt_tpu/bench/scenarios.py).
+
+Pins:
+- publishes to an OFFLINE persistent session land in BOTH the session
+  queue and the sqlite message store (storage.messages_stored);
+- resume delivers everything; a mid-delivery TAKEOVER (same client id,
+  new connection, unacked in-flight window) transfers the window and
+  redelivers it with DUP=1 — zero lost, duplicates only where MQTT
+  permits them (unacked QoS1/2);
+- within one connection no payload is delivered twice (the queue holds
+  distinct messages; dedup is per-window);
+- the whole dance produces NO reason-labeled drops.
+"""
+
+import asyncio
+import tempfile
+
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.plugins.message_storage import MessageStoragePlugin
+
+from tests.mqtt_client import TestClient
+
+
+def _drops(ctx) -> dict:
+    return {k: v for k, v in ctx.metrics.to_json().items()
+            if k.startswith("messages.dropped") and v}
+
+
+def durable_broker_test(fn):
+    def wrapper():
+        async def run():
+            with tempfile.TemporaryDirectory() as td:
+                b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+                b.ctx.plugins.register(MessageStoragePlugin(
+                    b.ctx, {"path": f"{td}/messages.db"}))
+                await b.start()
+                try:
+                    await asyncio.wait_for(fn(b), timeout=60.0)
+                finally:
+                    await b.stop()
+
+        asyncio.run(run())
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+async def _background_load(broker, stop: asyncio.Event) -> int:
+    """Concurrent QoS1 pub/sub stream on unrelated topics: the durable
+    dance must survive a busy broker, not an idle one."""
+    sub = await TestClient.connect(broker.port, "bg-sub")
+    await sub.subscribe("bg/#", qos=1)
+    publ = await TestClient.connect(broker.port, "bg-pub")
+    n = 0
+    try:
+        while not stop.is_set():
+            await publ.publish(f"bg/{n % 5}", b"load", qos=1)
+            await sub.recv()
+            n += 1
+            await asyncio.sleep(0)
+    finally:
+        await sub.close()
+        await publ.close()
+    return n
+
+
+@durable_broker_test
+async def test_durable_qos12_storage_takeover_inflight_resend(broker):
+    ctx = broker.ctx
+    stop = asyncio.Event()
+    bg = asyncio.ensure_future(_background_load(broker, stop))
+
+    # persistent subscriber (v3.1.1 clean_session=0 → default expiry),
+    # one QoS1 and one QoS2 filter, then offline
+    sub = await TestClient.connect(broker.port, "durable", clean_start=False)
+    await sub.subscribe("dur/q1/#", qos=1)
+    await sub.subscribe("dur/q2/#", qos=2)
+    await sub.close()
+
+    publ = await TestClient.connect(broker.port, "dur-pub")
+    expected = set()
+    for i in range(20):
+        p1 = f"q1-{i}".encode()
+        await publ.publish("dur/q1/t", p1, qos=1)
+        expected.add(p1)
+        p2 = f"q2-{i}".encode()
+        await publ.publish("dur/q2/t", p2, qos=2)
+        expected.add(p2)
+    await publ.close()
+
+    # stored through the sqlite message store, queued on the session
+    assert ctx.metrics.get("storage.messages_stored") >= 40
+    assert ctx.message_mgr is not None and ctx.message_mgr.count() >= 40
+    sess = ctx.registry.get("durable")
+    assert sess is not None and not sess.connected
+    assert len(sess.deliver_queue) == 40
+
+    # resume with acking DISABLED (auto_ack must ride the connect call —
+    # deliveries race any later attribute flip): the in-flight window
+    # fills with unacked QoS1/2 entries
+    sub2 = await TestClient.connect(broker.port, "durable",
+                                    clean_start=False, auto_ack=False)
+    assert sub2.connack.session_present
+    got_first = []
+    for _ in range(8):
+        got_first.append(await sub2.recv(timeout=10.0))
+    await asyncio.sleep(0.1)
+    assert len(sess.out_inflight) > 0  # unacked window is genuinely open
+    unacked = {bytes(p.payload) for p in got_first}
+
+    # TAKEOVER: same client id, new connection, normal acking. The broker
+    # kicks the old connection, transfers the unacked window to the front
+    # of the queue with DUP, and delivers everything.
+    sub3 = await TestClient.connect(broker.port, "durable",
+                                    clean_start=False)
+    assert sub3.connack.session_present
+    seen = {}
+    dup_redeliveries = 0
+    deadline = asyncio.get_event_loop().time() + 30.0
+    while (set(seen) != expected
+           and asyncio.get_event_loop().time() < deadline):
+        try:
+            p = await sub3.recv(timeout=2.0)
+        except asyncio.TimeoutError:
+            continue
+        payload = bytes(p.payload)
+        # within ONE connection every queued message arrives exactly once
+        assert payload not in seen, f"double delivery to one conn: {payload}"
+        seen[payload] = p
+        if p.dup:
+            dup_redeliveries += 1
+
+    # zero lost: every published payload reached the durable subscriber
+    assert set(seen) == expected
+    # the unacked in-flight window was REDELIVERED (dup=1 on the wire) —
+    # cross-connection duplicates exactly where MQTT permits them
+    assert dup_redeliveries > 0
+    redelivered = {p for p in unacked if p in seen and seen[p].dup}
+    assert redelivered, "no unacked entry was resent with DUP after takeover"
+    # and nothing was dropped anywhere in the dance
+    assert _drops(ctx) == {}
+
+    stop.set()
+    n_bg = await bg
+    assert n_bg > 0  # the background stream genuinely ran concurrently
+    await sub2.close()
+    await sub3.close()
+
+
+@durable_broker_test
+async def test_durable_replay_from_storage_on_new_subscribe(broker):
+    """The storage half on its own: a LATE subscriber (no session at
+    publish time) gets the stored messages replayed at subscribe, and
+    mark_forwarded prevents a second replay on re-subscribe."""
+    ctx = broker.ctx
+    publ = await TestClient.connect(broker.port, "rp-pub")
+    for i in range(5):
+        await publ.publish("replay/t", f"r-{i}".encode(), qos=1)
+    await publ.close()
+    assert ctx.metrics.get("storage.messages_stored") >= 5
+
+    sub = await TestClient.connect(broker.port, "rp-sub", clean_start=False)
+    await sub.subscribe("replay/#", qos=1)
+    got = {bytes((await sub.recv(timeout=10.0)).payload) for _ in range(5)}
+    assert got == {f"r-{i}".encode() for i in range(5)}
+    # marked forwarded: a re-subscribe must not replay them again
+    await sub.unsubscribe("replay/#")
+    await sub.subscribe("replay/#", qos=1)
+    await sub.expect_nothing(timeout=0.6)
+    assert _drops(ctx) == {}
+    await sub.close()
