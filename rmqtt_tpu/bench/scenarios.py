@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -348,6 +349,11 @@ class Profile:
     #: profiles whose broker shape needs env knobs or real process
     #: isolation refuse the in-process fast path
     subprocess_only: bool = False
+    #: custom orchestrator: a profile that cannot run as phases against ONE
+    #: broker (the multi-node cluster scenarios) supplies its own
+    #: ``async runner(profile, inproc, workdir) -> ScenarioReport`` and
+    #: run_profile_async delegates to it wholesale
+    runner: Optional[Callable] = None
 
 
 def _free_port() -> int:
@@ -993,6 +999,338 @@ _profile(Profile(
     ),
 ))
 
+# ------------------------------------------- multi-node cluster scenario
+class ClusterProcNode:
+    """One broker process of a scenario cluster: broadcast mode, fast
+    membership knobs, HTTP admin API (membership polls + failpoint arming
+    ride the same surface operators use)."""
+
+    def __init__(self, idx: int, workdir: str, mports: List[int],
+                 cports: List[int], aports: List[int]) -> None:
+        self.idx = idx  # 1-based node id
+        self.workdir = workdir
+        self.port = mports[idx - 1]
+        self.api_port = aports[idx - 1]
+        peers = ", ".join(
+            f'"{j + 1}@127.0.0.1:{cports[j]}"'
+            for j in range(len(cports)) if j != idx - 1)
+        self.conf = Path(workdir) / f"node{idx}.toml"
+        self.conf.write_text(f"""
+[listener]
+host = "127.0.0.1"
+port = {self.port}
+
+[node]
+id = {idx}
+
+[cluster]
+listen = "127.0.0.1:{cports[idx - 1]}"
+mode = "broadcast"
+peers = [{peers}]
+heartbeat_interval = 0.25
+suspect_timeout = 0.75
+dead_timeout = 1.5
+alive_hold = 1
+
+[http_api]
+host = "127.0.0.1"
+port = {self.api_port}
+
+[log]
+to = "off"
+""")
+        self.proc: Optional[subprocess.Popen] = None
+
+    def spawn(self) -> None:
+        log_f = open(Path(self.workdir) / f"node{self.idx}.log", "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "rmqtt_tpu.broker",
+             "--config", str(self.conf)],
+            cwd=str(REPO), env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=log_f, stderr=log_f)
+        log_f.close()
+
+    async def wait_ready(self, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        for port in (self.port, self.api_port):
+            while True:
+                if self.proc is not None and self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node {self.idx} exited rc={self.proc.returncode}")
+                try:
+                    with socket.create_connection(("127.0.0.1", port),
+                                                  timeout=0.3):
+                        break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"node {self.idx} never listened")
+                    await asyncio.sleep(0.15)
+
+    async def api(self, path: str, method: str = "GET", obj: Any = None):
+        status, body = await _http_json(self.api_port, path, method, obj)
+        if status != 200:
+            raise RuntimeError(f"node {self.idx} {method} {path} -> {status}")
+        return body
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+async def _peer_state(node: ClusterProcNode, nid: int) -> Optional[str]:
+    body = await node.api("/api/v1/cluster")
+    for row in body.get("membership", {}).get("peers", []):
+        if row["node"] == nid:
+            return row["state"]
+    return None
+
+
+async def _wait_peer_state(node: ClusterProcNode, nid: int, state: str,
+                           timeout: float = 25.0) -> float:
+    """Poll one node's membership view until ``nid`` is ``state``; returns
+    the observation timestamp (time.monotonic)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if await _peer_state(node, nid) == state:
+                return time.monotonic()
+        except Exception:
+            pass
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"node {nid} never {state} from node {node.idx}")
+
+
+async def _wait_digests_equal(nodes: List[ClusterProcNode],
+                              timeout: float = 25.0) -> float:
+    """Seconds until every node reports the same retained-store digest."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while time.monotonic() < deadline:
+        try:
+            ds = [
+                (await n.api("/api/v1/cluster"))["digests"]["retain"]["digest"]
+                for n in nodes
+            ]
+            if len(set(ds)) == 1:
+                return time.monotonic() - t0
+        except Exception:
+            pass
+        await asyncio.sleep(0.2)
+    raise TimeoutError("retained digests never converged")
+
+
+async def run_cluster_partition_heal(profile: Profile, inproc: bool = False,
+                                     workdir: Optional[str] = None) -> dict:
+    """The multi-node scenario ROADMAP item 5 left open: a 3-node
+    broadcast cluster under live QoS1 traffic is SIGKILLed, restarted,
+    fully partitioned (cluster.rpc failpoint over the live HTTP API) and
+    healed. The report carries the partition-tolerance metrics: detection
+    time, CONNECT latency during the outage (fast-fail kick), anti-entropy
+    convergence times, the duplicate-session fence verdict, and zero loss
+    for the surviving traffic path."""
+    if inproc:
+        raise ValueError("cluster profiles need real processes")
+    report = base_report(profile.name, "subprocess")
+    report["descr"] = profile.descr
+    mports = [_free_port() for _ in range(3)]
+    cports = [_free_port() for _ in range(3)]
+    aports = [_free_port() for _ in range(3)]
+    acked: List[bytes] = []
+    stop_traffic = asyncio.Event()
+    traffic: Optional[asyncio.Task] = None
+    clients: List[MiniClient] = []
+
+    with tempfile.TemporaryDirectory() as td:
+        wd = workdir or td
+        nodes = [ClusterProcNode(i, wd, mports, cports, aports)
+                 for i in (1, 2, 3)]
+        try:
+            for n in nodes:
+                n.spawn()
+            for n in nodes:
+                await n.wait_ready()
+            # ---- phase: membership converges to all-ALIVE
+            t0 = time.monotonic()
+            for n in nodes:
+                for other in nodes:
+                    if other is not n:
+                        await _wait_peer_state(n, other.idx, "ALIVE")
+            report["phases"].append({
+                "name": "membership_converge", "ok": True,
+                "seconds": round(time.monotonic() - t0, 3)})
+            # ---- live QoS1 traffic: node 1 → node 2, for the whole run
+            sub = await MiniClient.connect(nodes[1].port, "cph-sub")
+            clients.append(sub)
+            await sub.subscribe("cph/t", qos=1)
+            pub = await MiniClient.connect(nodes[0].port, "cph-pub")
+            clients.append(pub)
+
+            async def stream():
+                seq = 0
+                while not stop_traffic.is_set():
+                    payload = f"cph-{seq}".encode()
+                    try:
+                        await pub.publish("cph/t", payload, qos=1)
+                        acked.append(payload)
+                    except (ConnectionError, asyncio.TimeoutError, OSError):
+                        await asyncio.sleep(0.1)
+                    seq += 1
+                    await asyncio.sleep(0.02)
+
+            traffic = asyncio.ensure_future(stream())
+            await asyncio.sleep(1.0)
+            # ---- phase: SIGKILL node 3 mid-traffic
+            t_kill = time.monotonic()
+            nodes[2].kill()
+            t_seen = await _wait_peer_state(nodes[0], 3, "DEAD")
+            await _wait_peer_state(nodes[1], 3, "DEAD")
+            detect_s = t_seen - t_kill
+            # CONNECT during the outage: the kick must skip the dead peer
+            t_c = time.monotonic()
+            probe = await MiniClient.connect(nodes[0].port, "cph-probe")
+            clients.append(probe)
+            connect_s = time.monotonic() - t_c
+            await probe.close()
+            # retained divergence while node 3 is down
+            for i in range(8):
+                await pub.publish(f"cph/keep/{i}", f"k{i}".encode(),
+                                  qos=1, retain=True)
+            report["phases"].append({
+                "name": "node_kill", "ok": connect_s < 2.0 and detect_s < 5.0,
+                "seconds": round(time.monotonic() - t_kill, 3),
+                "detect_s": round(detect_s, 3),
+                "connect_during_outage_s": round(connect_s, 3)})
+            # ---- phase: node 3 rejoins; anti-entropy reconverges it
+            nodes[2].spawn()
+            await nodes[2].wait_ready()
+            await _wait_peer_state(nodes[0], 3, "ALIVE")
+            rejoin_converge_s = await _wait_digests_equal(nodes)
+            report["phases"].append({
+                "name": "rejoin", "ok": True,
+                "seconds": round(rejoin_converge_s, 3),
+                "converge_s": round(rejoin_converge_s, 3)})
+            # ---- phase: full partition of node 3 + duplicate session
+            t_p = time.monotonic()
+            await nodes[2].api("/api/v1/failpoints", "PUT",
+                               {"cluster.rpc": "error"})
+            await _wait_peer_state(nodes[0], 3, "DEAD")
+            await _wait_peer_state(nodes[2], 1, "DEAD")
+            dup_a = await MiniClient.connect(nodes[0].port, "cph-dup")
+            clients.append(dup_a)
+            dup_b = await MiniClient.connect(nodes[2].port, "cph-dup")
+            clients.append(dup_b)
+            pub3 = await MiniClient.connect(nodes[2].port, "cph-pub3")
+            clients.append(pub3)
+            await pub3.publish("cph/keep/part", b"island", qos=1, retain=True)
+            await nodes[2].api("/api/v1/failpoints", "PUT",
+                               {"cluster.rpc": "off"})
+            await _wait_peer_state(nodes[0], 3, "ALIVE")
+            await _wait_peer_state(nodes[2], 1, "ALIVE")
+            partition_converge_s = await _wait_digests_equal(nodes)
+            # exactly one cph-dup survivor, fence-resolved
+            kicks = live = 0
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                stats = [(await n.api("/api/v1/stats"))[0]["stats"]
+                         for n in nodes]
+                kicks = sum(s["cluster_fence_kicks"] for s in stats)
+                # /api/v1/clients is cluster-merged — one node's listing
+                # names every live copy, keyed by owning node_id
+                found = {
+                    c["node_id"]
+                    for c in await nodes[0].api("/api/v1/clients")
+                    if c.get("clientid") == "cph-dup" and c.get("connected")
+                }
+                live = len(found)
+                if kicks >= 1 and live == 1:
+                    break
+                await asyncio.sleep(0.25)
+            report["phases"].append({
+                "name": "partition_fence",
+                "ok": kicks == 1 and live == 1,
+                "seconds": round(time.monotonic() - t_p, 3),
+                "converge_s": round(partition_converge_s, 3),
+                "fence_kicks": kicks, "dup_survivors": live})
+            # ---- drain: every acked publish reached the subscriber
+            stop_traffic.set()
+            await traffic
+            want = set(acked)
+            got: set = set()
+            deadline = time.monotonic() + 30.0
+            while not want <= got and time.monotonic() < deadline:
+                try:
+                    p = await asyncio.wait_for(sub.publishes.get(), 1.0)
+                    got.add(p.payload)
+                except asyncio.TimeoutError:
+                    pass
+            lost = len(want - got)
+            active_s = time.monotonic() - t0
+            report["phases"].append({
+                "name": "steady_traffic", "ok": lost == 0,
+                "published": len(acked), "delivered": len(want & got),
+                "lost": lost, "seconds": round(active_s, 3)})
+            report["goodput"] = {
+                "published": len(acked), "delivered": len(want & got),
+                "phase_seconds": round(active_s, 3),
+                "delivered_per_s": (round(len(want & got) / active_s, 1)
+                                    if active_s else 0.0),
+            }
+            report["cluster"] = {
+                "nodes": 3,
+                "detect_s": round(detect_s, 3),
+                "connect_during_outage_s": round(connect_s, 3),
+                "rejoin_converge_s": round(rejoin_converge_s, 3),
+                "partition_converge_s": round(partition_converge_s, 3),
+                "fence_kicks": kicks,
+            }
+        except Exception as e:
+            report["errors"].append(f"{type(e).__name__}: {e}")
+        finally:
+            # the failure path must not strand the stream task or leak
+            # client sockets — a timed-out phase still tears down cleanly
+            stop_traffic.set()
+            if traffic is not None:
+                traffic.cancel()
+                try:
+                    await traffic
+                except (asyncio.CancelledError, Exception):
+                    pass
+            for c in clients:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            for n in nodes:
+                n.stop()
+    report["slo"] = {"state": None, "objectives": []}
+    ok = (not report["errors"]
+          and all(p.get("ok") for p in report["phases"]))
+    return finish_report(report, ok)
+
+
+_profile(Profile(
+    name="cluster_partition_heal",
+    descr="3-node broadcast cluster under live QoS1 traffic: SIGKILL + "
+          "rejoin, full partition + heal; membership detection, fast-fail "
+          "CONNECTs during the outage, anti-entropy digest convergence, "
+          "duplicate-session fence resolution, zero loss on the surviving "
+          "path",
+    steps=(),
+    subprocess_only=True,
+    runner=run_cluster_partition_heal,
+))
+
+
 #: tier-1 wiring (tests/test_slo.py), chaos_matrix.FAST_SUBSET-style
 FAST_SUBSET = ["smoke_fast"]
 
@@ -1034,6 +1372,8 @@ async def run_profile_async(name, inproc: bool = False,
     legacy wrappers build scaled copies) end to end; returns the
     ScenarioReport."""
     profile = name if isinstance(name, Profile) else PROFILES[name]
+    if profile.runner is not None:
+        return await profile.runner(profile, inproc=inproc, workdir=workdir)
     report = base_report(profile.name, "inproc" if inproc else "subprocess")
     report["descr"] = profile.descr
     with tempfile.TemporaryDirectory() as td:

@@ -418,6 +418,23 @@ class ServerContext:
         # SLO gauges (broker/slo.py): worst objective state + transitions
         s.slo_state = int(self.slo.worst_state)
         s.slo_transitions = self.slo.transitions
+        # cluster membership + partition-healing gauges
+        # (cluster/membership.py); the counters exist (zero) on single-node
+        # brokers too, so dashboards keep one shape
+        cluster = getattr(self.registry, "cluster", None)
+        ms = getattr(cluster, "membership", None)
+        if ms is not None:
+            counts = ms.state_counts()
+            s.cluster_peers_alive = counts["alive"]
+            s.cluster_peers_suspect = counts["suspect"]
+            s.cluster_peers_dead = counts["dead"]
+        s.cluster_membership_transitions = self.metrics.get(
+            "cluster.membership.transitions")
+        s.cluster_retain_sync_dropped = self.metrics.get(
+            "messages.dropped.retain_sync")
+        s.cluster_fence_kicks = self.metrics.get("cluster.fence_kicks")
+        s.cluster_anti_entropy_runs = self.metrics.get(
+            "cluster.anti_entropy.runs")
         # process RSS (utils/sysmon.py — same probe the overload sampler
         # uses); sums to a cluster memory total in /stats/sum
         from rmqtt_tpu.utils.sysmon import rss_mb

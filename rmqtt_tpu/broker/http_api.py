@@ -417,6 +417,17 @@ class HttpApi:
             # live error budgets + burn rates (broker/slo.py); shape-stable
             # with the engine disabled (objectives listed, zero data)
             return 200, {"node": ctx.node_id, **ctx.slo.snapshot()}, J
+        if path == "/api/v1/cluster":
+            # membership failure-detector view + anti-entropy state + the
+            # convergence digests (cluster/membership.py); shape-stable on
+            # single-node brokers ({"enabled": false} + fence clock)
+            cluster = getattr(ctx.registry, "cluster", None)
+            out = {"node": ctx.node_id,
+                   "enabled": cluster is not None,
+                   "fence_epoch": getattr(ctx.registry, "fence_epoch", 0)}
+            if cluster is not None:
+                out.update(cluster.snapshot())
+            return 200, out, J
         if path == "/api/v1/overload":
             # overload-controller state (broker/overload.py): watermark
             # state + signals, admission counters, shed totals, breakers;

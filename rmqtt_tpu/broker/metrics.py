@@ -113,6 +113,19 @@ class Stats:
         self.routing_switchbacks = 0
         self.routing_failover_host_routed = 0
         self.routing_device_failures = 0
+        # cluster membership + partition-healing gauges
+        # (cluster/membership.py), filled by ServerContext.stats(); zeros
+        # on single-node brokers so the surface stays shape-stable.
+        # peers_* count the failure detector's current view; the rest are
+        # monotonic repair/loss counters (retain_sync_dropped = pushes lost
+        # to unreachable peers, visible until anti-entropy heals them)
+        self.cluster_peers_alive = 0
+        self.cluster_peers_suspect = 0
+        self.cluster_peers_dead = 0
+        self.cluster_membership_transitions = 0
+        self.cluster_retain_sync_dropped = 0
+        self.cluster_fence_kicks = 0
+        self.cluster_anti_entropy_runs = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

@@ -12,6 +12,7 @@ north star.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 from rmqtt_tpu.core.topic import filter_valid, topic_valid
@@ -41,6 +42,12 @@ class RetainStore:
         # cluster hook: called as on_set(topic, msg_or_None) after a local
         # mutation (broadcast-mode retain_set_broadcast analogue)
         self.on_set = None
+        # store revision: bumped on every content mutation so digest()
+        # recomputes only when the store actually changed (the membership
+        # API polls the digest — an O(n log n) pass per poll would stall
+        # the event loop at scale)
+        self._rev = 0
+        self._digest_cache: Optional[Tuple[int, Dict[str, object]]] = None
 
     def count(self) -> int:
         return self._tree.count()
@@ -70,12 +77,14 @@ class RetainStore:
         if self._tree.get(topic) is None and self._tree.count() >= self.max_retained:
             return False
         self._tree.insert(topic, msg)
+        self._rev += 1
         if self._tpu:
             self._set_row(topic, msg)
         return True
 
     def remove_local(self, topic: str) -> None:
         self._tree.remove(topic)
+        self._rev += 1
         self._drop_row(topic)
 
     def all_items(self) -> List[Tuple[str, Message]]:
@@ -85,8 +94,7 @@ class RetainStore:
     def get(self, topic: str) -> Optional[Message]:
         msg = self._tree.get(topic)
         if msg is not None and msg.is_expired():
-            self._tree.remove(topic)
-            self._drop_row(topic)
+            self.remove_local(topic)
             return None
         return msg
 
@@ -101,18 +109,60 @@ class RetainStore:
         fresh = []
         for topic, msg in out:
             if msg.is_expired():
-                self._tree.remove(topic)
-                self._drop_row(topic)
+                self.remove_local(topic)
             else:
                 fresh.append((topic, msg))
         return fresh
+
+    def digest(self) -> Dict[str, object]:
+        """Content digest over every live retained (topic, create_time,
+        payload): byte-equal across nodes iff the stores converged —
+        ``create_time`` rides the retain-sync wire, so replicas agree after
+        a successful sync. The anti-entropy exchange
+        (cluster/membership.py) compares this before moving any payloads.
+        Cached against the store revision, so membership-API polls only
+        recompute after an actual mutation (expired entries still drop out:
+        their removal on first touch bumps the revision)."""
+        if (self._digest_cache is not None
+                and self._digest_cache[0] == self._rev):
+            return dict(self._digest_cache[1])
+        h = hashlib.sha1()
+        n = 0
+        expired = []
+        for topic, m in sorted(self.all_items()):
+            if m.is_expired():
+                expired.append(topic)
+                continue
+            h.update(topic.encode())
+            h.update(b"\x00")
+            h.update(repr(m.create_time).encode())
+            h.update(hashlib.sha1(m.payload).digest())
+            n += 1
+        for t in expired:
+            # reap now (bumps the revision) so the cached digest stays
+            # consistent with what a recompute would produce
+            self.remove_local(t)
+        out = {"count": n, "digest": h.hexdigest()}
+        self._digest_cache = (self._rev, dict(out))
+        return out
+
+    def summary(self) -> Dict[str, list]:
+        """Per-topic repair summary ``{topic: [create_time, payload_hash]}``
+        — what the anti-entropy delta plan compares instead of shipping
+        payloads (cluster/membership.py retain_delta)."""
+        out: Dict[str, list] = {}
+        for topic, m in self.all_items():
+            if m.is_expired():
+                continue
+            out[topic] = [m.create_time,
+                          hashlib.sha1(m.payload).hexdigest()[:12]]
+        return out
 
     def expire_sweep(self) -> int:
         """Periodic expiry cleanup (retainer plugin's cleanup loop)."""
         expired = ["/".join(levels) for levels, msg in self._tree.items() if msg.is_expired()]
         for t in expired:
-            self._tree.remove(t)
-            self._drop_row(t)
+            self.remove_local(t)
         return len(expired)
 
     # ---- TPU mirror -------------------------------------------------------

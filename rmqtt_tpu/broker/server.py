@@ -580,6 +580,7 @@ async def _amain(args) -> None:
                 broker.ctx, settings.cluster_listen, settings.peers,
                 raft_db=settings.raft_db,
                 retain_sync_mode=settings.retain_sync_mode,
+                **settings.cluster_tuning,
             )
         else:
             from rmqtt_tpu.cluster.broadcast import BroadcastCluster
@@ -587,6 +588,7 @@ async def _amain(args) -> None:
             cluster = BroadcastCluster(
                 broker.ctx, settings.cluster_listen, settings.peers,
                 retain_sync_mode=settings.retain_sync_mode,
+                **settings.cluster_tuning,
             )
         await cluster.start()
     api = None

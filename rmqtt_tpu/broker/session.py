@@ -100,6 +100,13 @@ class Session:
         self.will: Optional[pk.Will] = connect_info_will(connect_info)
         self._will_task: Optional[asyncio.Task] = None
         self._expiry_task: Optional[asyncio.Task] = None
+        # session fencing epoch (cluster/membership.py): every takeover
+        # stamps a monotonic (epoch, node_id) via registry.next_fence(), so
+        # a healed partition resolves duplicate sessions deterministically
+        # — highest fence wins, the stale side self-kicks (exactly once:
+        # _fence_kicked guards the racing repair paths)
+        self.fence: tuple = (0, id.node_id)
+        self._fence_kicked = False
 
     # ---------------------------------------------------------------- fanout
     def enqueue(self, item: DeliverItem) -> None:
@@ -256,6 +263,7 @@ def session_snapshot(s: Session, max_queue_items: Optional[int] = None) -> dict:
         "keepalive": s.connect_info.keepalive,
         "subs": [[tf, opts_to_wire(o)] for tf, o in s.subscriptions.items()],
         "queue": items,
+        "fence": list(s.fence),
     }
 
 
@@ -283,6 +291,12 @@ async def restore_session(ctx, snap: dict, node_id: Optional[int] = None) -> Opt
         max_packet_size=ctx.cfg.max_packet_size,
     )
     session = Session(ctx, sid, ci, limits, clean_start=False)
+    session.fence = tuple(snap.get("fence", (0, sid.node_id)))
+    # the restored fence must also advance the local clock, or the next
+    # local takeover could stamp a LOWER fence than the state it resumes
+    observe = getattr(ctx.registry, "observe_fence", None)
+    if observe is not None:
+        observe(session.fence[0])
     ctx.registry._sessions[snap["client_id"]] = session
     for tf, ow in snap["subs"]:
         opts = opts_from_wire(ow)

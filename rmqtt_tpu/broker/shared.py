@@ -28,6 +28,28 @@ class SessionRegistry:
     def __init__(self, ctx) -> None:
         self.ctx = ctx
         self._sessions: Dict[str, Session] = {}
+        # session-fence clock (cluster/membership.py): a Lamport-style
+        # monotonic epoch counter. Locally it only ever increments; cluster
+        # modes merge peers' epochs in via observe_fence (heartbeats +
+        # restored snapshots), so a takeover AFTER a partition heals always
+        # out-fences both partition-era owners.
+        self._fence_epoch = 0
+
+    # ------------------------------------------------------------- fencing
+    @property
+    def fence_epoch(self) -> int:
+        return self._fence_epoch
+
+    def next_fence(self) -> tuple:
+        """A fence strictly above every epoch this node has seen; the
+        node id tie-breaks concurrent takeovers deterministically."""
+        self._fence_epoch += 1
+        return (self._fence_epoch, self.ctx.cfg.node_id)
+
+    def observe_fence(self, epoch: int) -> None:
+        """Merge a remotely-seen epoch (heartbeat piggyback / restore)."""
+        if epoch > self._fence_epoch:
+            self._fence_epoch = epoch
 
     # ------------------------------------------------------------- registry
     def get(self, client_id: str) -> Optional[Session]:
@@ -66,9 +88,13 @@ class SessionRegistry:
                 existing.clean_start = clean_start
                 existing.will = connect_info.will
                 existing.transfer_inflight_to_queue()
+                # a resume is a change of ownership too: re-fence so a
+                # concurrent owner elsewhere loses the heal-time conflict
+                existing.fence = self.next_fence()
                 return existing, True
             await self.terminate(existing, "takeover-clean")
         session = Session(ctx, id, connect_info, limits, clean_start)
+        session.fence = self.next_fence()
         self._sessions[id.client_id] = session
         await ctx.hooks.fire(HookType.SESSION_CREATED, id, None, None)
         return session, False

@@ -23,6 +23,13 @@ from rmqtt_tpu.broker.shared import SessionRegistry
 from rmqtt_tpu.broker.tracing import CURRENT_TRACE
 from rmqtt_tpu.broker.types import Message
 from rmqtt_tpu.cluster import messages as M
+from rmqtt_tpu.cluster.membership import (
+    _SYNC_UNHANDLED,
+    Membership,
+    handle_sync_message,
+    retain_digest,
+    routes_digest,
+)
 from rmqtt_tpu.cluster.transport import (
     Broadcaster,
     ClusterReplyError,
@@ -56,6 +63,142 @@ def _bg_notify(cluster, peer, mtype: str, body) -> None:
             log.warning("%s to node %s failed", mtype, peer.node_id)
 
     _spawn(cluster, push())
+
+
+class ClusterNode:
+    """Peer-mesh behavior shared by both cluster modes: the peer table with
+    overload-registry breakers, the membership failure detector
+    (cluster/membership.py), DEAD-peer filtering for the fan-out paths, and
+    the retain-sync push with reason-labeled loss accounting."""
+
+    def _init_mesh(
+        self,
+        ctx,
+        listen: Tuple[str, int],
+        peers: List[Tuple[int, str, int]],
+        sync_retains: bool,
+        retain_sync_mode: str,
+        heartbeat_interval: float = 1.0,
+        suspect_timeout: float = 3.0,
+        dead_timeout: float = 6.0,
+        alive_hold: int = 2,
+        anti_entropy: bool = True,
+    ) -> None:
+        self.ctx = ctx
+        self.server = ClusterServer(listen[0], listen[1], self._on_message)
+        self.peers: Dict[int, PeerClient] = {
+            nid: PeerClient(nid, host, port) for nid, host, port in peers
+        }
+        # per-peer circuit breakers come FROM the overload registry so the
+        # [overload] breaker_* knobs apply to cluster transport and a dead
+        # peer is visible in /api/v1/overload and $SYS (broker/overload.py)
+        for nid, p in self.peers.items():
+            p.breaker = ctx.overload.breaker(f"cluster.peer.{nid}")
+        self.bcast = Broadcaster(list(self.peers.values()))
+        # "full": replicate every retain set + startup pull; "topic_only":
+        # no replication, lazy per-filter fetch at subscribe time
+        # (retain.rs:162 RetainSyncMode Full vs TopicOnly)
+        self.retain_sync_mode = retain_sync_mode
+        self.sync_retains = sync_retains and retain_sync_mode == "full"
+        # strong refs: asyncio holds tasks weakly — an unreferenced
+        # background task could be GC'd before it runs
+        self._bg_tasks: set = set()
+        # heartbeat failure detector + anti-entropy driver ([cluster]
+        # heartbeat/suspect/dead knobs); reads self.peers live, so peers
+        # injected after start() (test meshes) are probed too
+        self.membership = Membership(
+            self, ctx,
+            heartbeat_interval=heartbeat_interval,
+            suspect_timeout=suspect_timeout,
+            dead_timeout=dead_timeout,
+            alive_hold=alive_hold,
+            anti_entropy=anti_entropy,
+        )
+        ctx.retain.on_set = self._on_retain_set
+
+    @property
+    def bound_port(self) -> int:
+        return self.server.bound_port
+
+    def spawn(self, coro) -> None:
+        _spawn(self, coro)
+
+    # ----------------------------------------------------- peer filtering
+    def live_peers(self) -> List[PeerClient]:
+        """Peers worth scattering to: membership says not DEAD. SUSPECT
+        peers still get traffic (they may only be slow); DEAD peers are
+        skipped immediately instead of paying a per-call timeout."""
+        ms = self.membership
+        return [p for p in self.peers.values() if not ms.is_dead(p.node_id)]
+
+    def kickable_peers(self) -> List[PeerClient]:
+        """Peers a takeover kick must consult: DEAD peers and circuit-open
+        peers (breaker OPEN, probe window not yet due) hold no reachable
+        session by definition — treating them as "no session there" keeps
+        CONNECT latency bounded by the heartbeat window, not the RPC
+        timeout."""
+        ms = self.membership
+        out = []
+        for p in self.peers.values():
+            if ms.is_dead(p.node_id):
+                continue
+            b = p.breaker
+            if b.state == b.OPEN and b.remaining() > 0:
+                continue
+            out.append(p)
+        return out
+
+    def snapshot(self) -> dict:
+        """/api/v1/cluster body: membership + repair state + the digests
+        the anti-entropy exchange compares (convergence is observable).
+        The retain digest is revision-cached in the store (exact); the
+        subscription-directory digest is an O(routes) pass with no cheap
+        version key, so it is TTL-cached here — admin polls see at most
+        ``heartbeat_interval`` of staleness instead of hashing a 10M-route
+        table per request (the repair path always recomputes)."""
+        now = time.monotonic()
+        cached = getattr(self, "_routes_digest_cache", None)
+        if cached is None or now - cached[0] > self.membership.heartbeat_interval:
+            cached = (now, routes_digest(self.ctx.router))
+            self._routes_digest_cache = cached
+        return {
+            "mode": getattr(self, "mode", "broadcast"),
+            "retain_sync_mode": self.retain_sync_mode,
+            "membership": self.membership.snapshot(),
+            "digests": {
+                "retain": retain_digest(self.ctx.retain),
+                "subs": cached[1],
+            },
+        }
+
+    # ----------------------------------------------------- retain push
+    def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
+        """Replicate a retained set/clear to peers (full mode). Pushes that
+        cannot be delivered — peer DEAD, or the notify fails — are counted
+        as reason-labeled drops (``messages.dropped.retain_sync``) so
+        divergence is visible until anti-entropy heals it on rejoin."""
+        if self.retain_sync_mode != "full":
+            return  # TopicOnly: peers fetch lazily at subscribe time
+        body = {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None}
+
+        async def push():
+            ms = self.membership
+            targets, dead = [], 0
+            for p in self.peers.values():
+                if ms.is_dead(p.node_id):
+                    dead += 1
+                else:
+                    targets.append(p)
+            if dead:
+                self.ctx.metrics.drop("retain_sync", dead)
+            if targets:
+                errs = await Broadcaster(targets).join_all_notify(
+                    M.SET_RETAIN, body)
+                failed = sum(1 for e in errs if e is not None)
+                if failed:
+                    self.ctx.metrics.drop("retain_sync", failed)
+
+        self.spawn(push())
 
 
 async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=None) -> object:
@@ -238,6 +381,10 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
         return {"data": None}
     if mtype == M.PING:
         return {"pong": True}
+    # membership heartbeats + anti-entropy exchange (cluster/membership.py)
+    res = await handle_sync_message(ctx, mtype, body, cluster=cluster)
+    if res is not _SYNC_UNHANDLED:
+        return res
     return _UNHANDLED
 
 
@@ -253,12 +400,23 @@ class ClusterRegistryBase(SessionRegistry):
         # tell peers to drop any session with this id and WAIT for their
         # confirmation (broadcast-mode kick, src/lib.rs:179-200); a resumable
         # session's state comes back in the reply and is rebuilt locally
-        # (the reference's SessionStateTransfer)
+        # (the reference's SessionStateTransfer). Peers the membership
+        # detector marks DEAD — or whose circuit is open — hold no
+        # reachable session by definition: they are skipped outright, so a
+        # killed node costs CONNECTs nothing once detected (the heartbeat
+        # window, not the RPC timeout, bounds the stall) and the rejoin
+        # anti-entropy fence pass cleans up any conflict that slips through
         if self.cluster is not None and self.cluster.peers:
-            replies = await self.cluster.bcast.join_all_call(
-                M.KICK, {"client_id": id.client_id, "clean_start": clean_start}
-            )
-            await self._restore_transferred(ctx, id, clean_start, replies)
+            peers = self.cluster.kickable_peers()
+            skipped = len(self.cluster.peers) - len(peers)
+            if skipped:
+                self.ctx.metrics.inc("cluster.kick_skipped", skipped)
+            if peers:
+                replies = await Broadcaster(peers).join_all_call(
+                    M.KICK,
+                    {"client_id": id.client_id, "clean_start": clean_start},
+                )
+                await self._restore_transferred(ctx, id, clean_start, replies)
         return await super().take_or_create(ctx, id, connect_info, limits, clean_start)
 
     async def retain_load_with(self, topic_filter: str):
@@ -272,7 +430,7 @@ class ClusterRegistryBase(SessionRegistry):
         if c is None or not c.peers or c.retain_sync_mode != "topic_only":
             return local
         best = {topic: msg for topic, msg in local}
-        for _nid, reply in await c.bcast.join_all_call(
+        for _nid, reply in await Broadcaster(c.live_peers()).join_all_call(
             M.GET_RETAINS, {"filter": topic_filter, "match": True}
         ):
             if isinstance(reply, Exception):
@@ -332,13 +490,14 @@ class ClusterSessionRegistry(ClusterRegistryBase):
             if self._sessions.get(msg.target_clientid) is not None:
                 return await super().forwards(msg)
             try:
-                await cluster.bcast.select_ok(M.FORWARDS_TO, {
-                    "msg": M.msg_to_wire(msg),
-                    "rels": [],
-                    "p2p": msg.target_clientid,
-                    "from_node": self.ctx.node_id,
-                    "trace": tw,
-                })
+                await Broadcaster(cluster.live_peers()).select_ok(
+                    M.FORWARDS_TO, {
+                        "msg": M.msg_to_wire(msg),
+                        "rels": [],
+                        "p2p": msg.target_clientid,
+                        "from_node": self.ctx.node_id,
+                        "trace": tw,
+                    })
                 return 1
             except (PeerUnavailable, ClusterReplyError):
                 return 0  # no node owns this client
@@ -346,14 +505,17 @@ class ClusterSessionRegistry(ClusterRegistryBase):
         raw = await self.ctx.routing.matches_raw(msg.from_id, msg.topic)
         relmap, shared = raw
         count, _ = self._deliver_relmap(relmap, msg, trace)
-        # 2) scatter: peers deliver their non-shared and reply candidates
+        # 2) scatter: LIVE peers deliver their non-shared and reply
+        # candidates; membership-DEAD peers are skipped outright (a dead
+        # node must not add a call timeout to every publish)
+        scatter = cluster.live_peers()
         t_fw = time.perf_counter_ns() if trace is not None else 0
-        replies = await cluster.bcast.join_all_call(
+        replies = await Broadcaster(scatter).join_all_call(
             M.FORWARDS, {"msg": M.msg_to_wire(msg), "trace": tw}
         )
         if trace is not None:
             trace.add("cluster.forward", t_fw, time.perf_counter_ns() - t_fw,
-                      {"mode": "broadcast", "peers": len(cluster.peers)})
+                      {"mode": "broadcast", "peers": len(scatter)})
         mgr = getattr(self.ctx, "message_mgr", None)
         merged: Dict[Tuple[str, str], list] = {k: list(v) for k, v in shared.items()}
         for node_id, reply in replies:
@@ -390,6 +552,11 @@ class ClusterSessionRegistry(ClusterRegistryBase):
             peer = cluster.peers.get(node_id)
             if peer is None:
                 continue
+            if cluster.membership.is_dead(node_id):
+                # targeted shared-sub deliveries to a DEAD node: lost, but
+                # lost FAST and reason-labeled (no per-publish timeout)
+                self.ctx.metrics.drop("peer_dead", len(rels))
+                continue
             try:
                 await peer.notify(M.FORWARDS_TO, {
                     "msg": M.msg_to_wire(msg),
@@ -423,7 +590,9 @@ class ClusterSessionRegistry(ClusterRegistryBase):
                     recipients.append(rel.id.client_id)
         return count, recipients
 
-class BroadcastCluster:
+class BroadcastCluster(ClusterNode):
+    mode = "broadcast"
+
     def __init__(
         self,
         ctx,
@@ -431,45 +600,26 @@ class BroadcastCluster:
         peers: List[Tuple[int, str, int]],
         sync_retains: bool = True,
         retain_sync_mode: str = "full",
+        **membership_opts,
     ) -> None:
-        self.ctx = ctx
-        self.server = ClusterServer(listen[0], listen[1], self._on_message)
-        self.peers: Dict[int, PeerClient] = {
-            nid: PeerClient(nid, host, port) for nid, host, port in peers
-        }
-        # per-peer circuit breakers come FROM the overload registry so the
-        # [overload] breaker_* knobs apply to cluster transport and a dead
-        # peer is visible in /api/v1/overload and $SYS (broker/overload.py)
-        for nid, p in self.peers.items():
-            p.breaker = ctx.overload.breaker(f"cluster.peer.{nid}")
-        self.bcast = Broadcaster(list(self.peers.values()))
-        # "full": replicate every retain set + startup pull; "topic_only":
-        # no replication, lazy per-filter fetch at subscribe time
-        # (retain.rs:162 RetainSyncMode Full vs TopicOnly)
-        self.retain_sync_mode = retain_sync_mode
-        self.sync_retains = sync_retains and retain_sync_mode == "full"
+        self._init_mesh(ctx, listen, peers, sync_retains, retain_sync_mode,
+                        **membership_opts)
         assert isinstance(ctx.registry, ClusterSessionRegistry), (
             "cluster mode needs ServerContext(registry='cluster')"
         )
         ctx.registry.cluster = self
-        # broadcast retained sets to peers (retain_set_broadcast analogue)
-        ctx.retain.on_set = self._on_retain_set
-        # strong refs: asyncio holds tasks weakly — an unreferenced broadcast
-        # task could be GC'd before it runs
-        self._bg_tasks: set = set()
-
-    @property
-    def bound_port(self) -> int:
-        return self.server.bound_port
 
     async def start(self) -> None:
         await self.server.start()
+        self.membership.start()
 
     async def start_sync(self) -> None:
         """Pull retained messages from peers (startup sync, lib.rs:146-149)."""
         if not self.sync_retains:
             return
-        for node_id, reply in await self.bcast.join_all_call(M.GET_RETAINS, {"filter": "#"}):
+        for node_id, reply in await Broadcaster(self.live_peers()).join_all_call(
+            M.GET_RETAINS, {"filter": "#"}
+        ):
             if isinstance(reply, Exception):
                 continue
             for topic, mw in reply.get("retains", []):
@@ -477,21 +627,10 @@ class BroadcastCluster:
                 self.ctx.retain.set_local(topic, msg)
 
     async def stop(self) -> None:
+        await self.membership.stop()
         await self.server.stop()
         for p in self.peers.values():
             await p.close()
-
-    # ----------------------------------------------------------- outbound
-    def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
-        if self.retain_sync_mode != "full":
-            return  # TopicOnly: peers fetch lazily at subscribe time
-        async def push():
-            await self.bcast.join_all_notify(
-                M.SET_RETAIN,
-                {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
-            )
-
-        _spawn(self, push())
 
     # ------------------------------------------------------------ inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:

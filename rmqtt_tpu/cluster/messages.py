@@ -34,6 +34,16 @@ ROUTES_GET_BY = "routes_get_by"  # grpc.rs RoutesGetBy(Topic)
 MESSAGE_GET = "message_get"  # cross-node stored-message fetch (merge_on_read)
 PING = "ping"
 DATA = "data"
+# membership + anti-entropy vocabulary (cluster/membership.py): the failure
+# detector's periodic probe (carries incarnation + fence clock) and the
+# rejoin repair protocol — digests first, deltas only where they differ
+HEARTBEAT = "heartbeat"
+SYNC_DIGEST = "sync_digest"  # retained-store + subscription-directory digests
+SYNC_RETAIN_SUMMARY = "sync_retain_summary"  # {topic: [ct, payload_hash]}
+SYNC_RETAIN_PULL = "sync_retain_pull"  # fetch named topics' retained msgs
+SYNC_RETAIN_PUSH = "sync_retain_push"  # deliver newer-here retained msgs
+SYNC_SESSIONS = "sync_sessions"  # duplicate-session fence resolution
+SYNC_ROUTES = "sync_routes"  # raft-mode route-table pull (repair fallback)
 
 # reply tags
 OK = "ok"

@@ -25,6 +25,7 @@ from rmqtt_tpu.cluster import messages as M
 from rmqtt_tpu.cluster.broadcast import (
     _UNHANDLED,
     _spawn,
+    ClusterNode,
     ClusterRegistryBase,
     handle_common_message,
 )
@@ -38,8 +39,6 @@ from rmqtt_tpu.cluster.raft import (
 from rmqtt_tpu.cluster.transport import (
     Broadcaster,
     ClusterReplyError,
-    ClusterServer,
-    PeerClient,
     PeerUnavailable,
 )
 from rmqtt_tpu.router.base import Id, SubRelation
@@ -178,6 +177,12 @@ class RaftSessionRegistry(ClusterRegistryBase):
             peer = c.peers.get(node_id)
             if peer is None:
                 continue
+            if c.membership.is_dead(node_id):
+                # the replicated table still lists the dead node's
+                # subscribers; dropping fast + reason-labeled beats paying
+                # a breaker-mediated connect attempt per publish
+                self.ctx.metrics.drop("peer_dead", len(rels))
+                continue
             try:
                 await peer.notify(M.FORWARDS_TO, {
                     "msg": M.msg_to_wire(msg),
@@ -196,8 +201,10 @@ class RaftSessionRegistry(ClusterRegistryBase):
         return count
 
 
-class RaftCluster:
+class RaftCluster(ClusterNode):
     """Raft node + cluster RPC server, swapped in like the broadcast mode."""
+
+    mode = "raft"
 
     def __init__(
         self,
@@ -207,22 +214,10 @@ class RaftCluster:
         sync_retains: bool = True,
         raft_db: Optional[str] = None,
         retain_sync_mode: str = "full",
+        **membership_opts,
     ) -> None:
-        self.ctx = ctx
-        self.server = ClusterServer(listen[0], listen[1], self._on_message)
-        self.peers: Dict[int, PeerClient] = {
-            nid: PeerClient(nid, host, port) for nid, host, port in peers
-        }
-        # per-peer breakers come from the overload registry ([overload]
-        # breaker_* knobs apply; broker/overload.py): raft heartbeats to a
-        # dead peer fail fast AND show up in the API
-        for nid, p in self.peers.items():
-            p.breaker = ctx.overload.breaker(f"cluster.peer.{nid}")
-        self.bcast = Broadcaster(list(self.peers.values()))
-        # retain.rs:162 RetainSyncMode: Full replicates; TopicOnly fetches
-        # per-filter at subscribe time (see ClusterRegistryBase.retain_load_with)
-        self.retain_sync_mode = retain_sync_mode
-        self.sync_retains = sync_retains and retain_sync_mode == "full"
+        self._init_mesh(ctx, listen, peers, sync_retains, retain_sync_mode,
+                        **membership_opts)
         storage = None
         if raft_db:
             from rmqtt_tpu.storage.sqlite import SqliteStore
@@ -236,8 +231,6 @@ class RaftCluster:
             "raft mode needs ServerContext with registry='raft'"
         )
         ctx.registry.cluster = self
-        ctx.retain.on_set = self._on_retain_set
-        self._bg_tasks: set = set()
         # distributed handshake-lock table (part of the replicated state):
         # client_id -> [node_id, ts, nonce]
         self.hs_locks: Dict[str, list] = {}
@@ -247,27 +240,27 @@ class RaftCluster:
         # up must not leave an orphan result behind)
         self._hs_pending: set = set()
 
-    @property
-    def bound_port(self) -> int:
-        return self.server.bound_port
-
     async def start(self) -> None:
         await self.server.start()
         # a storage-loaded snapshot must hit the router BEFORE the log
         # re-applies on top of it
         await self.raft.restore_pending()
         self.raft.start()
+        self.membership.start()
 
     async def start_sync(self) -> None:
         if not self.sync_retains or not self.peers:
             return
-        for _nid, reply in await self.bcast.join_all_call(M.GET_RETAINS, {"filter": "#"}):
+        for _nid, reply in await Broadcaster(self.live_peers()).join_all_call(
+            M.GET_RETAINS, {"filter": "#"}
+        ):
             if isinstance(reply, Exception):
                 continue
             for topic, mw in reply.get("retains", []):
                 self.ctx.retain.set_local(topic, M.msg_from_wire(mw))
 
     async def stop(self) -> None:
+        await self.membership.stop()
         await self.raft.stop()
         await self.server.stop()
         for p in self.peers.values():
@@ -376,17 +369,6 @@ class RaftCluster:
             "node": self.ctx.node_id, "nonce": nonce,
         }
         _spawn(self, self.raft.propose(entry, timeout=30.0))
-
-    def _on_retain_set(self, topic: str, msg: Optional[Message]) -> None:
-        if self.retain_sync_mode != "full":
-            return  # TopicOnly: peers fetch lazily at subscribe time
-        async def push():
-            await self.bcast.join_all_notify(
-                M.SET_RETAIN,
-                {"topic": topic, "msg": M.msg_to_wire(msg) if msg else None},
-            )
-
-        _spawn(self, push())
 
     # -------------------------------------------------------------- inbound
     async def _on_message(self, mtype: str, body: Any, _from_node) -> Any:

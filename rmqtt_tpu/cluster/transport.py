@@ -34,6 +34,14 @@ log = logging.getLogger("rmqtt_tpu.cluster")
 _FP_FORWARD = FAILPOINTS.register("cluster.forward")
 _FORWARD_TYPES = ("forwards", "forwards_to")  # messages.M constants
 
+#: partition seam: fires on EVERY cluster frame — outbound sends fail fast
+#: as PeerUnavailable (feeding the breaker), inbound frames are dropped
+#: before dispatch so the sender times out like a blackholed link. Arming
+#: ``error`` on one process therefore cuts it off symmetrically: its calls
+#: fail, and calls TO it stall to timeout — a network partition the
+#: membership detector (cluster/membership.py) must detect and heal from
+_FP_RPC = FAILPOINTS.register("cluster.rpc")
+
 MAX_FRAME = 8 * 1024 * 1024  # reference caps messages at 4MB (grpc.rs:154)
 
 
@@ -130,6 +138,12 @@ class PeerClient:
         self._pending.clear()
 
     async def _send(self, obj: dict) -> None:
+        if _FP_RPC.action is not None:
+            try:
+                await _FP_RPC.fire_async()
+            except FailpointError as e:
+                self.breaker.fail()
+                raise PeerUnavailable(str(e)) from e
         if _FP_FORWARD.action is not None and obj.get("t") in _FORWARD_TYPES:
             try:
                 await _FP_FORWARD.fire_async()
@@ -170,9 +184,17 @@ class PeerClient:
             self._pending.pop(corr, None)
 
     async def close(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
+        task, self._reader_task = self._reader_task, None
+        if task is not None:
+            task.cancel()
         self._teardown(ConnectionError("closed"))
+        if task is not None:
+            # await the cancelled reader so interpreter teardown never sees
+            # a half-dead task ("Task was destroyed but it is pending")
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
 
 
 # handler(mtype, body, from_node) -> reply value (or None)
@@ -236,6 +258,13 @@ class ClusterServer:
         try:
             while True:
                 frame = await _read_frame(reader)
+                if _FP_RPC.action is not None:
+                    # partition seam, inbound half: drop the frame silently
+                    # (the sender sees a stall, not an error — blackhole)
+                    try:
+                        await _FP_RPC.fire_async()
+                    except FailpointError:
+                        continue
                 task = asyncio.get_running_loop().create_task(dispatch(frame))
                 pending.add(task)
                 task.add_done_callback(pending.discard)

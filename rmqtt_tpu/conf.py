@@ -198,6 +198,10 @@ class Settings:
     default_startups: List[str]
     raw: Dict[str, Any]
     log: LogConfig = field(default_factory=LogConfig)
+    # membership/anti-entropy knobs ([cluster] heartbeat_interval /
+    # suspect_timeout / dead_timeout / alive_hold / anti_entropy), passed
+    # straight into the cluster constructors (cluster/membership.py)
+    cluster_tuning: Dict[str, Any] = field(default_factory=dict)
 
 
 def _apply_section(tree: Dict[str, Any], section: str,
@@ -399,12 +403,30 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
 
     cluster_listen = None
     raft_db = None
+    # every [cluster] key is named here; typos fail at load like the other
+    # sections (membership knobs feed cluster/membership.py)
+    _cluster_known = {
+        "listen", "mode", "peers", "raft_db", "retain_sync_mode",
+        "heartbeat_interval", "suspect_timeout", "dead_timeout",
+        "alive_hold", "anti_entropy",
+    }
+    unknown = set(cluster) - _cluster_known
+    if unknown:
+        raise ValueError(f"unknown [cluster] keys: {sorted(unknown)}")
     retain_sync_mode = str(cluster.get("retain_sync_mode", "full"))
     if retain_sync_mode not in ("full", "topic_only"):
         raise ValueError(
             f"cluster.retain_sync_mode must be 'full' or 'topic_only', "
             f"got {retain_sync_mode!r}"
         )
+    cluster_tuning: Dict[str, Any] = {}
+    for key, conv in (("heartbeat_interval", float),
+                      ("suspect_timeout", float),
+                      ("dead_timeout", float),
+                      ("alive_hold", int),
+                      ("anti_entropy", bool)):
+        if key in cluster:
+            cluster_tuning[key] = conv(cluster[key])
     peers: List[Tuple[int, str, int]] = []
     if cluster.get("listen"):
         host, _, port = str(cluster["listen"]).rpartition(":")
@@ -445,6 +467,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         default_startups=default_startups,
         raw=tree,
         log=log_cfg,
+        cluster_tuning=cluster_tuning,
     )
 
 

@@ -121,6 +121,7 @@ class SysTopicPlugin(Plugin):
             await self._publish_slo()
             await self._publish_overload()
             await self._publish_failover()
+            await self._publish_cluster()
             await asyncio.sleep(self.interval)
 
     async def _publish_latency(self) -> None:
@@ -197,6 +198,21 @@ class SysTopicPlugin(Plugin):
         await self._publish(
             f"{self._prefix}/routing/failover",
             json.dumps(fo.snapshot()).encode(),
+        )
+
+    async def _publish_cluster(self) -> None:
+        """$SYS/brokers/<node>/cluster/membership: the failure detector's
+        per-peer view + anti-entropy counters (cluster/membership.py).
+        Published only on clustered brokers — single-node $SYS trees are
+        unchanged. Kept publishing at ELEVATED like the overload topics:
+        partition state is exactly what an operator needs under stress."""
+        cluster = getattr(self.ctx.registry, "cluster", None)
+        ms = getattr(cluster, "membership", None)
+        if ms is None:
+            return
+        await self._publish(
+            f"{self._prefix}/cluster/membership",
+            json.dumps(ms.snapshot()).encode(),
         )
 
     async def _publish_tracing(self) -> None:

@@ -63,6 +63,8 @@ SITES: List[Tuple[str, str]] = [
     ("storage.write", "sqlite/redis store mutations (put/delete/bulk)"),
     ("storage.read", "sqlite/redis store reads (get/scan/count)"),
     ("cluster.forward", "cross-node publish forwarding (broadcast + raft)"),
+    ("cluster.rpc", "every cluster frame, both directions (partition: "
+                    "outbound fails fast, inbound is blackholed)"),
     ("bridge.egress", "bridge producer sends (kafka/pulsar/nats egress pumps)"),
 ]
 

@@ -196,6 +196,169 @@ async def cell_cluster(site: str, action: str) -> dict:
             await br.stop()
 
 
+async def cell_cluster_partition(site: str, action: str) -> dict:
+    """Full partition of a 2-node in-process broadcast cluster via the
+    ``cluster.rpc`` seam (one process registry = every link cut), then
+    heal: membership must mark peers DEAD within the configured window,
+    CONNECT must fast-fail the kick instead of paying the RPC timeout,
+    retain-sync loss must be counted, and the rejoin anti-entropy must
+    reconverge the retained stores and fence the duplicate session."""
+    from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+    from rmqtt_tpu.cluster.membership import PeerState, retain_digest
+    from rmqtt_tpu.cluster.transport import PeerClient
+
+    ms_opts = dict(heartbeat_interval=0.1, suspect_timeout=0.3,
+                   dead_timeout=0.6, alive_hold=1)
+    brokers, clusters = [], []
+    try:
+        for nid in (1, 2):
+            ctx = ServerContext(BrokerConfig(port=0, node_id=nid, cluster=True))
+            br = MqttBroker(ctx)
+            await br.start()
+            brokers.append(br)
+        for br in brokers:
+            c = BroadcastCluster(br.ctx, ("127.0.0.1", 0), [], **ms_opts)
+            await c.start()
+            clusters.append(c)
+        for i, c in enumerate(clusters):
+            for j, other in enumerate(clusters):
+                if i != j:
+                    nid = brokers[j].ctx.node_id
+                    c.peers[nid] = PeerClient(nid, "127.0.0.1",
+                                              other.bound_port)
+            c.bcast.peers = list(c.peers.values())
+        # warm: cross-node delivery + a session to duplicate later
+        sub = await TestClient.connect(brokers[1].port, "cp-dup")
+        await sub.subscribe("cp/#", qos=1)
+        pub = await TestClient.connect(brokers[0].port, "cp-pub")
+        await pub.publish("cp/warm", b"w", qos=1)
+        p = await sub.recv(timeout=5.0)
+        assert p.payload == b"w"
+
+        async def wait_state(c, nid, state, timeout=10.0):
+            deadline = time.time() + timeout
+            while c.membership.state_of(nid) != state:
+                assert time.time() < deadline, (
+                    f"node {nid} never became {state.name}")
+                await asyncio.sleep(0.05)
+
+        FAILPOINTS.set(site, action)  # the partition
+        t0 = time.time()
+        await wait_state(clusters[0], 2, PeerState.DEAD)
+        await wait_state(clusters[1], 1, PeerState.DEAD)
+        detect_s = time.time() - t0
+        # retain divergence during the partition is counted, not silent
+        await pub.publish("cp/keep", b"v-part", qos=1, retain=True)
+        await asyncio.sleep(0.3)
+        dropped = brokers[0].ctx.metrics.get("messages.dropped.retain_sync")
+        # fast-fail kick: the duplicate CONNECT on node 1 must not await
+        # the 5s RPC timeout against the partitioned peer
+        t1 = time.time()
+        dup = await TestClient.connect(brokers[0].port, "cp-dup")
+        connect_s = time.time() - t1
+        await dup.subscribe("cp/#", qos=1)
+        FAILPOINTS.set(site, "off")  # heal
+        await wait_state(clusters[0], 2, PeerState.ALIVE)
+        await wait_state(clusters[1], 1, PeerState.ALIVE)
+        # anti-entropy: retained stores byte-equal, exactly one cp-dup
+        # survives (highest fence wins — node 1's takeover is newer)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            d = [retain_digest(b.ctx.retain)["digest"] for b in brokers]
+            live = [b.ctx.registry.get("cp-dup") for b in brokers]
+            live_n = sum(1 for s in live if s is not None and s.connected)
+            if d[0] == d[1] and live_n == 1:
+                break
+            await asyncio.sleep(0.1)
+        fence_kicks = sum(b.ctx.metrics.get("cluster.fence_kicks")
+                          for b in brokers)
+        repairs = sum(b.ctx.metrics.get("cluster.anti_entropy.runs")
+                      for b in brokers)
+        return {
+            "ok": (d[0] == d[1] and live_n == 1 and dropped >= 1
+                   and connect_s < 2.0 and fence_kicks >= 1
+                   and repairs >= 1),
+            "detect_s": round(detect_s, 3),
+            "connect_during_partition_s": round(connect_s, 3),
+            "retain_sync_dropped": dropped,
+            "fence_kicks": fence_kicks,
+            "anti_entropy_runs": repairs,
+            "digests_equal": d[0] == d[1],
+            "dup_sessions_live": live_n,
+        }
+    finally:
+        FAILPOINTS.clear_all()
+        for c in clusters:
+            await c.stop()
+        for br in brokers:
+            await br.stop()
+
+
+async def cell_cluster_node_kill() -> dict:
+    """SIGKILL one node of a 2-process broadcast cluster: the survivor
+    must mark it DEAD within the configured window, CONNECTs must not
+    stall on the dead peer, and after a restart the retained stores must
+    reconverge to byte-equal digests (observed via /api/v1/cluster).
+    Reuses the scenario harness (bench/scenarios.ClusterProcNode) — one
+    node template, one set of membership knobs."""
+    import tempfile
+
+    from rmqtt_tpu.bench.scenarios import (
+        ClusterProcNode,
+        _free_port,
+        _wait_digests_equal,
+        _wait_peer_state,
+    )
+
+    mports = [_free_port(), _free_port()]
+    cports = [_free_port(), _free_port()]
+    aports = [_free_port(), _free_port()]
+    with tempfile.TemporaryDirectory() as td:
+        nodes = [ClusterProcNode(i, td, mports, cports, aports)
+                 for i in (1, 2)]
+        try:
+            for n in nodes:
+                n.spawn()
+            for n in nodes:
+                await n.wait_ready()
+            sub = await TestClient.connect(mports[1], "nk-sub")
+            await sub.subscribe("nk/#", qos=1)
+            pub = await TestClient.connect(mports[0], "nk-pub")
+            await pub.publish("nk/warm", b"w", qos=1)
+            p = await sub.recv(timeout=10.0)
+            assert p.payload == b"w"
+            # ---- SIGKILL node 2: no clean shutdown, no goodbye
+            t0 = time.monotonic()
+            nodes[1].kill()
+            t_dead = await _wait_peer_state(nodes[0], 2, "DEAD")
+            detect_s = t_dead - t0
+            # CONNECT with node 2's client id: the kick must not stall on
+            # the dead peer (bounded by detection, not the RPC timeout)
+            t1 = time.monotonic()
+            steal = await TestClient.connect(mports[0], "nk-sub")
+            connect_s = time.monotonic() - t1
+            await steal.close()
+            # retained divergence while node 2 is down
+            for i in range(5):
+                await pub.publish(f"nk/keep/{i}", f"v{i}".encode(),
+                                  qos=1, retain=True)
+            # ---- restart node 2; membership rejoin + repair reconverge it
+            nodes[1].spawn()
+            await nodes[1].wait_ready()
+            await _wait_peer_state(nodes[0], 2, "ALIVE")
+            converge_s = await _wait_digests_equal(nodes)
+            return {
+                "ok": detect_s < 5.0 and connect_s < 2.0,
+                "detect_s": round(detect_s, 3),
+                "connect_during_outage_s": round(connect_s, 3),
+                "rejoin_converge_s": round(converge_s, 3),
+                "digests_equal": True,  # _wait_digests_equal raised otherwise
+            }
+        finally:
+            for n in nodes:
+                n.stop()
+
+
 async def cell_bridge(site: str, action: str) -> dict:
     from rmqtt_tpu.plugins.bridge_mqtt import BridgeEgressMqttPlugin
 
@@ -249,12 +412,15 @@ MATRIX = {
     "storage.write:error": lambda: cell_storage("storage.write", "times(2, error)"),
     "storage.read:error": lambda: cell_storage("storage.read", "times(2, error)"),
     "cluster.forward:error": lambda: cell_cluster("cluster.forward", "times(1, error)"),
+    "cluster.rpc:partition": lambda: cell_cluster_partition("cluster.rpc", "error"),
+    "cluster.rpc:node_kill": lambda: cell_cluster_node_kill(),
     "bridge.egress:error": lambda: cell_bridge("bridge.egress", "times(1, error)"),
 }
 
-#: tier-1 subset (fast, no hang/delay cells): run by tests/test_failpoints.py
+#: tier-1 subset (fast, no hang/delay/subprocess cells): run by
+#: tests/test_failpoints.py
 FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
-               "bridge.egress:error"]
+               "bridge.egress:error", "cluster.rpc:partition"]
 
 
 async def run_matrix(cells=None) -> dict:

@@ -475,6 +475,175 @@ async def test_chaos_iterated_follower_kill_under_load(cc):
     assert not missing, f"{len(missing)}/{len(want)} acked messages lost"
 
 
+def _spawn_cfg_node(node_id: int, port: int, cport: int, api_port: int,
+                    peers: list, workdir) -> subprocess.Popen:
+    """A broadcast-mode node from a config file: the fence/partition test
+    needs the HTTP API (failpoint arming + membership polls) and fast
+    [cluster] membership knobs, which the bare CLI flags don't carry."""
+    conf = workdir / f"node{node_id}.toml"
+    peer_rows = ", ".join(f'"{nid}@127.0.0.1:{pport}"' for nid, pport in peers)
+    conf.write_text(f"""
+[listener]
+host = "127.0.0.1"
+port = {port}
+
+[node]
+id = {node_id}
+
+[cluster]
+listen = "127.0.0.1:{cport}"
+mode = "broadcast"
+peers = [{peer_rows}]
+heartbeat_interval = 0.25
+suspect_timeout = 0.75
+dead_timeout = 1.5
+alive_hold = 1
+
+[http_api]
+host = "127.0.0.1"
+port = {api_port}
+
+[log]
+to = "off"
+""")
+    return subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--config", str(conf)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def test_partition_duplicate_session_fence_heal(tmp_path):
+    """Satellite pin: partition a 2-process broadcast cluster (cluster.rpc
+    failpoint armed over the live HTTP API), connect the SAME client id on
+    both sides, heal — exactly one survivor remains (the higher fence; the
+    stale side gets a reason-labeled kick), the retained stores reconverge
+    to byte-equal digests, and the surviving session then receives every
+    acked publish (zero loss)."""
+    from rmqtt_tpu.bench.scenarios import _http_json
+
+    mports = _free_ports(2)
+    cports = _free_ports(2)
+    aports = _free_ports(2)
+    procs = {}
+
+    async def api(i, path, method="GET", obj=None):
+        status, body = await _http_json(aports[i - 1], path, method, obj)
+        assert status == 200, (path, status, body)
+        return body
+
+    async def peer_state(i, nid):
+        body = await api(i, "/api/v1/cluster")
+        for row in body.get("membership", {}).get("peers", []):
+            if row["node"] == nid:
+                return row["state"]
+        return None
+
+    async def wait_peer_state(i, nid, state, timeout=20.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while await peer_state(i, nid) != state:
+            assert asyncio.get_running_loop().time() < deadline, (
+                f"node {nid} never {state} as seen from node {i}")
+            await asyncio.sleep(0.1)
+
+    async def drive():
+        # the original owner of the contested client id lives on node 1
+        owner = await TestClient.connect(mports[0], "fence-c")
+        ack = await owner.subscribe("fence/#", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        pub2 = await TestClient.connect(mports[1], "fence-pub2")
+        await pub2.publish("fence/warm", b"w", qos=1)
+        p = await owner.recv(timeout=10.0)
+        assert p.payload == b"w"
+        # ---- partition: every cluster frame on both nodes is cut
+        for i in (1, 2):
+            await api(i, "/api/v1/failpoints", "PUT", {"cluster.rpc": "error"})
+        await wait_peer_state(1, 2, "DEAD")
+        await wait_peer_state(2, 1, "DEAD")
+        # divergence while split: retained writes land on ONE side each
+        await pub2.publish("fence/keep2", b"v2", qos=1, retain=True)
+        pub1 = await TestClient.connect(mports[0], "fence-pub1")
+        await pub1.publish("fence/keep1", b"v1", qos=1, retain=True)
+        # duplicate session: the same client id connects on node 2 — the
+        # kick cannot cross the partition, and must not stall on it either
+        t0 = asyncio.get_running_loop().time()
+        dup = await TestClient.connect(mports[1], "fence-c")
+        connect_s = asyncio.get_running_loop().time() - t0
+        assert connect_s < 2.0, f"CONNECT stalled {connect_s:.2f}s in partition"
+        ack = await dup.subscribe("fence/#", qos=1)
+        assert ack.reason_codes[0] < 0x80
+        # ---- heal
+        for i in (1, 2):
+            await api(i, "/api/v1/failpoints", "PUT", {"cluster.rpc": "off"})
+        await wait_peer_state(1, 2, "ALIVE")
+        await wait_peer_state(2, 1, "ALIVE")
+        # anti-entropy: digests byte-equal + exactly one fence kick
+        deadline = asyncio.get_running_loop().time() + 20.0
+        while True:
+            bodies = [await api(i, "/api/v1/cluster") for i in (1, 2)]
+            digests = [b["digests"]["retain"]["digest"] for b in bodies]
+            # /api/v1/stats rows are [{node, stats}, ...] with the LOCAL
+            # node first (peers are cluster-merged in) — sum each node's
+            # own gauge only, or a healed mesh double-counts
+            stats = [await api(i, "/api/v1/stats") for i in (1, 2)]
+            kicks = sum(s[0]["stats"]["cluster_fence_kicks"] for s in stats)
+            if digests[0] == digests[1] and kicks >= 1:
+                break
+            assert asyncio.get_running_loop().time() < deadline, (
+                f"never converged: digests={digests} kicks={kicks}")
+            await asyncio.sleep(0.25)
+        assert kicks == 1, f"expected exactly one fence kick, got {kicks}"
+        # the stale (older-fence) side self-kicked: node 1's owner dies,
+        # node 2's later takeover survives
+        await asyncio.wait_for(owner.closed.wait(), timeout=10.0)
+        # zero loss for the surviving session: every acked publish after
+        # the heal reaches it, including across the node boundary
+        want = set()
+        for i in range(20):
+            payload = f"post-{i}".encode()
+            await pub1.publish("fence/t", payload, qos=1)
+            want.add(payload)
+        # the dup's subscribe already queued retained deliveries — drain
+        # until every wanted payload arrives, tolerating those extras
+        # (_drain_until's subset check would bail on the first one)
+        got: set = set()
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while not want <= got and asyncio.get_running_loop().time() < deadline:
+            try:
+                got.add((await dup.recv(timeout=1.0)).payload)
+            except asyncio.TimeoutError:
+                pass
+        missing = want - got
+        assert not missing, f"{len(missing)}/{len(want)} acked messages lost"
+        await dup.close()
+        await pub1.close()
+        await pub2.close()
+
+    try:
+        for i in (1, 2):
+            peers = [(j, cports[j - 1]) for j in (1, 2) if j != i]
+            procs[i] = _spawn_cfg_node(i, mports[i - 1], cports[i - 1],
+                                       aports[i - 1], peers, tmp_path)
+        for p in mports + aports:
+            _wait_port(p)
+        asyncio.run(asyncio.wait_for(drive(), timeout=120.0))
+    finally:
+        errs = {}
+        for i, proc in procs.items():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for i, proc in procs.items():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            if proc.stderr is not None:
+                tail = proc.stderr.read()[-2000:]
+                if tail and "Traceback" in tail:
+                    errs[i] = tail
+        assert not errs, f"node stderr tracebacks: {errs}"
+
+
 @_chaos_test
 async def test_chaos_flaky_links_survive_and_recover(cc):
     """Packet-loss analogue (chaos packet_loss.rs): every cluster link
