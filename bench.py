@@ -385,6 +385,20 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
                 stream = measure_stream(matcher, topics)
                 if stream is not None:
                     variants["stream"] = stream
+            # analytic HBM model against THIS table + topic stream, embedded
+            # next to the measured rate so every artifact carries its own
+            # modeled-vs-measured delta (roofline claim checkable per run)
+            try:
+                from rmqtt_tpu.bench.roofline_model import model_table
+                from rmqtt_tpu.core.topic import split_levels
+
+                ncs = [len(table._candidates_for(split_levels(tp)))
+                       for tp in topics[:2048]]
+                variants["roofline_model"] = model_table(
+                    table, ncs,
+                    measured_topics_per_sec=variants[kind]["topics_per_sec"])
+            except Exception as e:  # the bench must not die on the model
+                log(f"  roofline model skipped: {e}")
         del table, fids, matcher
     best_kind = max(kinds, key=lambda k: variants[k]["topics_per_sec"])
     tpu = variants[best_kind]
@@ -408,6 +422,8 @@ def run_config(name, filters, topics, batch_size, cpu_sample, retained=None):
         res["stream"] = variants.pop("stream")
     if "retained" in variants:
         res["retained"] = variants.pop("retained")
+    if "roofline_model" in variants:
+        res["roofline_model"] = variants.pop("roofline_model")
     nat = f" native {cpu_native['topics_per_sec']:.0f}" if cpu_native else ""
     rtr = (f" | router(hybrid→{hyb.get('hybrid_choice')}) "
            f"{hyb['topics_per_sec']:.0f} topics/s "
@@ -1219,6 +1235,99 @@ def run_churn_config(name, rng, reduced):
     return res
 
 
+def run_smallbatch_config(name, rng, reduced):
+    """Config 11: the cfg1 small-batch regime, attributable PER STAGE.
+
+    cfg1's standing 0.06x on chip is a single ratio — it cannot say whether
+    the loss sits in host encode, device dispatch, result fetch or host
+    decode. This config drives MICRO-batches (16 topics, the cfg1 shape)
+    through two matchers over ONE table — the fused match→compact→decode
+    pipeline vs the unfused words+host-decode path — as cfg7-style
+    order-symmetric pairs (order alternates per pair, so a host-noise
+    stall lands on both legs equally), with ``stage_timing`` accumulating
+    encode/dispatch/fetch/decode wall ns inside each matcher. Emits
+    per-leg p50/p99, per-stage shares, and the fused/unfused median pair
+    ratio: the DECODE share collapsing on the fused leg is the acceptance
+    evidence that host decode left the per-batch path."""
+    import os
+
+    from rmqtt_tpu.ops.partitioned import PartitionedMatcher
+
+    n, pairs, bs = (600, 48, 16) if reduced else (1000, 96, 16)
+    filters = gen_exact(rng, n)
+    # cfg1 shape: ~50% of publishes hit a subscribed topic
+    topics = [rng.choice(filters) if rng.random() < 0.5
+              else _tree_topic(rng, 4) for _ in range(pairs * bs)]
+    log(f"[{name}] {n} subs, {pairs} pairs of micro-batches of {bs}")
+    table, fids = build_tpu_table(filters, "partitioned")
+    m_fused = PartitionedMatcher(table)
+    prior = os.environ.get("RMQTT_FUSED")
+    os.environ["RMQTT_FUSED"] = "0"
+    try:
+        m_plain = PartitionedMatcher(table)
+    finally:
+        if prior is None:
+            os.environ.pop("RMQTT_FUSED", None)
+        else:
+            os.environ["RMQTT_FUSED"] = prior
+    batches = [topics[i: i + bs] for i in range(0, len(topics), bs)]
+    batches = [b for b in batches if len(b) == bs]
+    for m in (m_fused, m_plain):  # warmup/compile + fused verify
+        m.match(batches[0])
+        m.match(batches[1])
+        m.prewarm((bs,))
+        m.stage_timing = True
+
+    lat = {"fused": [], "unfused": []}
+    ratios = []
+    t0 = time.perf_counter()
+    for i, b in enumerate(batches):
+        def one(m, key):
+            t1 = time.perf_counter()
+            m.match(b)
+            lat[key].append(time.perf_counter() - t1)
+        if i % 2:
+            one(m_plain, "unfused")
+            one(m_fused, "fused")
+        else:
+            one(m_fused, "fused")
+            one(m_plain, "unfused")
+        ratios.append(lat["fused"][-1] / max(1e-9, lat["unfused"][-1]))
+    wall = time.perf_counter() - t0
+    ratios.sort()
+
+    def leg(key, m):
+        ls = sorted(lat[key])
+        total = max(1, sum(m.stage_ns.values()))
+        return {
+            "p50_ms": round(ls[len(ls) // 2] * 1e3, 3),
+            "p99_ms": round(ls[min(len(ls) - 1, int(len(ls) * 0.99))] * 1e3, 3),
+            "stage_ms": {k: round(v / 1e6, 2) for k, v in m.stage_ns.items()},
+            "stage_share": {k: round(v / total, 4)
+                            for k, v in m.stage_ns.items()},
+        }
+
+    res = {
+        "name": name,
+        "table_size": len(fids),
+        "micro_batch": bs,
+        "pairs": len(batches),
+        "topics_per_sec": round(2 * len(batches) * bs / wall, 1),
+        "fused_verified": m_fused._fused is True,
+        "fused": leg("fused", m_fused),
+        "unfused": leg("unfused", m_plain),
+        "median_pair_ratio": round(ratios[len(ratios) // 2], 3),
+        "decode_share_unfused": leg("unfused", m_plain)["stage_share"]["decode"],
+        "decode_share_fused": leg("fused", m_fused)["stage_share"]["decode"],
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] fused p50 {res['fused']['p50_ms']}ms vs unfused "
+        f"{res['unfused']['p50_ms']}ms (median pair ratio "
+        f"{res['median_pair_ratio']}x) | decode share "
+        f"{res['decode_share_unfused']:.1%} → {res['decode_share_fused']:.1%}")
+    return res
+
+
 def run_failover_config(name, rng, reduced):
     """Config 10: device-plane failover soak (broker/failover.py).
 
@@ -1480,12 +1589,12 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 10
+            return i <= 11
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
-        # (overload soak) and cfg9 (churn soak / delta uploads) are cheap,
-        # host-side and always informative
-        return i <= 3 or i in (6, 7, 8, 9, 10) or args.full or on_tpu
+        # (overload soak), cfg9 (churn soak / delta uploads) and cfg11
+        # (small-batch stage attribution) are cheap and always informative
+        return i <= 3 or i in (6, 7, 8, 9, 10, 11) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -1600,6 +1709,13 @@ def main():
 
         guarded("cfg10_failover_soak", cfg10)
 
+    if want(11):
+        def cfg11():
+            return run_smallbatch_config("cfg11_smallbatch_paired", rng,
+                                         reduced)
+
+        guarded("cfg11_smallbatch_paired", cfg11)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -1608,6 +1724,23 @@ def main():
     overload_res = results.pop("cfg8_overload_soak", None)
     churn_res = results.pop("cfg9_churn_soak", None)
     failover_res = results.pop("cfg10_failover_soak", None)
+    smallbatch_res = results.pop("cfg11_smallbatch_paired", None)
+    if (not results and smallbatch_res is not None and failover_res is None
+            and churn_res is None and overload_res is None
+            and tele_res is None and cache_res is None):
+        # a --config 11 run (chip hunter window): its own artifact shape
+        print(json.dumps({
+            "metric": "smallbatch_fused_pair_ratio[cfg11_smallbatch_paired]",
+            "value": smallbatch_res["median_pair_ratio"],
+            "unit": "x_fused_over_unfused",
+            "vs_baseline": smallbatch_res["median_pair_ratio"],
+            "decode_share_unfused": smallbatch_res["decode_share_unfused"],
+            "decode_share_fused": smallbatch_res["decode_share_fused"],
+            "platform": platform,
+            "smallbatch_paired": smallbatch_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        return
     if (not results and failover_res is not None and churn_res is None
             and overload_res is None and tele_res is None and cache_res is None):
         sb = failover_res["time_to_switchback_s"]
@@ -1748,6 +1881,8 @@ def main():
                 } if v.get("router") else {}),
                 **({"stream": v["stream"]} if "stream" in v else {}),
                 **({"retained": v["retained"]} if "retained" in v else {}),
+                **({"roofline_model": v["roofline_model"]}
+                   if "roofline_model" in v else {}),
                 **({"reduced_sizes": True} if reduced else {}),
             }
             for k, v in results.items()
@@ -1766,10 +1901,17 @@ def main():
         # failover soak (cfg10): goodput dip + time-to-switchback evidence
         # for the device-plane failover (broker/failover.py)
         **({"failover_soak": failover_res} if failover_res is not None else {}),
+        # small-batch paired estimator (cfg11): per-stage attribution of
+        # the cfg1 regime, fused vs unfused (ops/partitioned.py)
+        **({"smallbatch_paired": smallbatch_res}
+           if smallbatch_res is not None else {}),
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }
-    _persist_last_tpu(out, on_tpu)
+    # gate persistence on the RESOLVED platform, not just the probe: a
+    # probe false-positive that still lands on CPU devices must not
+    # clobber the last real on-chip snapshot with CPU numbers
+    _persist_last_tpu(out, on_tpu and platform == "tpu")
     print(json.dumps(out))
 
 
@@ -1781,6 +1923,13 @@ def _persist_last_tpu(out: dict, on_tpu: bool) -> None:
     still carries the last on-chip numbers (clearly labeled as prior-run)
     instead of emitting a near-zero-information CPU artifact (round 2 lost
     its real progress to exactly this)."""
+    import os
+
+    if os.environ.get("RMQTT_BENCH_NO_PERSIST") == "1":
+        # A/B legs (chip_hunter phase 2) run deliberately-degraded configs
+        # (RMQTT_FUSED=0 / RMQTT_PACKED=0): their numbers must never merge
+        # into the standing last-on-chip snapshot
+        return
     try:
         if on_tpu:
             snap = {k: out[k] for k in

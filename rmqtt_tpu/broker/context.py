@@ -77,6 +77,10 @@ class BrokerConfig:
     batch_linger_ms: float = 0.0  # 0 = latency-adaptive (no linger)
     # max routing batches past submit at once (1 = serial dispatch)
     routing_pipeline_depth: int = 3
+    # pre-compile the device matcher's small-batch dispatch shapes at
+    # start (background thread) so the first lone publishes don't pay an
+    # XLA compile; no-op for routers without a device matcher
+    routing_prewarm: bool = True
     # device-table churn resilience (ops/partitioned.py): incremental HBM
     # delta uploads (scatter only dirty chunks; off = full re-upload per
     # mutation) and background compaction (off = synchronous compact())
@@ -259,6 +263,7 @@ class ServerContext:
             max_batch=self.cfg.batch_max,
             linger_ms=self.cfg.batch_linger_ms,
             pipeline_depth=self.cfg.routing_pipeline_depth,
+            prewarm=self.cfg.routing_prewarm,
             cache_enable=self.cfg.route_cache,
             cache_capacity=self.cfg.route_cache_capacity,
             cache_shared_bypass=self.cfg.route_cache_shared_bypass,

@@ -51,6 +51,7 @@ class RoutingService:
         linger_ms: float = 0.0,
         max_queue: int = 100_000,
         pipeline_depth: int = 3,
+        prewarm: bool = True,
         cache_enable: bool = True,
         cache_capacity: int = 8192,
         cache_shared_bypass: bool = False,
@@ -80,6 +81,12 @@ class RoutingService:
         self._pipe_sem: Optional[asyncio.Semaphore] = None  # built in start()
         self._completion_q: asyncio.Queue = asyncio.Queue()
         self._completer: Optional[asyncio.Task] = None
+        # small-batch fast path: device routers pre-compile their tiny
+        # dispatch shapes off the hot path at start() (and latch a sticky
+        # pad floor), so cfg1-style traffic — one publish per dispatch —
+        # hits an already-compiled executable instead of paying a fresh
+        # XLA compile per distinct small shape
+        self.prewarm = prewarm
         # device-plane failover (broker/failover.py), wired by ServerContext
         # for device routers with a host trie mirror; None keeps every
         # dispatch guard a single attribute test
@@ -153,6 +160,7 @@ class RoutingService:
             # /stats/sum), NOT _ms (averaged like latency percentiles)
             "routing_compact_ms_total": d.get("compact_ms", 0.0),
             "routing_cand_cache_invalidations": d.get("cand_cache_invalidations", 0),
+            "routing_fused_batches": d.get("fused_batches", 0),
             # device-plane failover gauges (broker/failover.py): zeros when
             # failover is not wired so the surface stays shape-stable.
             # state: 0 = device (healthy), 1 = host fallback, 2 = probing
@@ -180,6 +188,10 @@ class RoutingService:
         if self._completer is None and hasattr(self.router, "submit_batch_raw"):
             self._pipe_sem = asyncio.Semaphore(self.pipeline_depth)
             self._completer = loop.create_task(self._complete_loop())
+        if self.prewarm and hasattr(self.router, "prewarm"):
+            # background thread: compiling the small shapes can take
+            # seconds on a real chip and must not stall broker start
+            loop.run_in_executor(None, self.router.prewarm)
 
     async def stop(self) -> None:
         if self.failover is not None:

@@ -314,6 +314,7 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "batch_max": ("batch_max", int),
         "linger_ms": ("batch_linger_ms", float),
         "pipeline_depth": ("routing_pipeline_depth", int),
+        "prewarm": ("routing_prewarm", bool),
         # device-table churn resilience (ops/partitioned.py): incremental
         # HBM delta uploads + background compaction trigger
         "delta_uploads": ("routing_delta_uploads", bool),

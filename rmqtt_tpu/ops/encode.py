@@ -23,7 +23,7 @@ the host staging copy is numpy, the device copy is donated on refresh).
 from __future__ import annotations
 
 import bisect
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -36,6 +36,66 @@ UNK_TOK = 3
 _FIRST_TOK = 4
 
 _MIN_CAPACITY = 1024
+
+# ---------------------------------------------------------------- bit-packed
+# tile layout (the "packed8" automaton format). Tokens are re-keyed into
+# PER-LEVEL local id spaces (reserved ids 0-3 shared with the global space),
+# so one byte covers a level whose local vocabulary fits 252 tokens and two
+# bytes cover up to 65532 — against the global int16/int32 id space the
+# legacy tiles ship. Rows become a sequence of byte PLANES (one or two per
+# level, plus one metadata byte packing flen+1 | has_hash<<5 | first_wild<<6;
+# prefix_len is derivable as flen - has_hash and is not stored). Byte planes
+# are grouped four-per-int32-lane so the device array is int32 with a
+# 128-multiple minor dim (TPU DMA alignment) and no sublane padding — see
+# ops/partitioned.py pack_device_rows_packed for the array construction.
+
+#: local ids 4..255 → 252 one-byte tokens per level; 65532 for two bytes
+PACKED_W1_MAX = 252
+PACKED_W2_MAX = 65532
+#: metadata byte stores flen+1 in 5 bits → filters at most 30 levels deep
+PACKED_MAX_LEVELS = 30
+
+
+class PackedLayout(NamedTuple):
+    """Static descriptor of one packed-tile layout (hashable → usable as a
+    jit static argument). ``widths[i]`` is level i's byte width; the level
+    planes are laid out in order followed by the metadata plane, then padded
+    to a multiple of four planes for the int32 lane grouping."""
+
+    widths: Tuple[int, ...]
+
+    @property
+    def nlvl(self) -> int:
+        return len(self.widths)
+
+    @property
+    def planes(self) -> int:
+        return sum(self.widths) + 1  # + metadata plane
+
+    @property
+    def groups(self) -> int:
+        return (self.planes + 3) // 4
+
+    def plane_offsets(self) -> List[int]:
+        """Byte-plane index of each level's LOW byte (metadata plane sits at
+        index ``planes - 1``)."""
+        out: List[int] = []
+        p = 0
+        for w in self.widths:
+            out.append(p)
+            p += w
+        return out
+
+
+def group_byte_planes(planes: np.ndarray, groups: int) -> np.ndarray:
+    """``[rows, planes] uint8`` → ``[rows, groups]`` int32 lanes, four byte
+    planes per lane (little-endian: plane 4g in bits 0-7). The padding
+    planes beyond ``planes.shape[1]`` are zero."""
+    rows, p = planes.shape
+    padded = np.zeros((rows, groups * 4), dtype=np.uint8)
+    padded[:, :p] = planes
+    b = padded.reshape(rows, groups, 4).astype(np.int32)
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
 
 
 class DeltaLog:

@@ -49,7 +49,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from rmqtt_tpu.ops.encode import PLUS_TOK
+from rmqtt_tpu.ops.encode import PLUS_TOK, PackedLayout
 
 BT = 8  # topics per program = one full VPU sublane dimension
 
@@ -180,6 +180,152 @@ def match_words_pallas(packed_rows, ttok, tlen, tdollar, chunk_ids,
     # chunk-major [B/BT, nc, BT, WPC] → topic-major [B, NC*WPC] (the
     # caller's contract); a single XLA transpose-copy, trivial next to the
     # scan it replaces
+    return (
+        out.reshape(b // BT, nc, BT, wpc)
+        .transpose(0, 2, 1, 3)
+        .reshape(b, nc * wpc)
+    )
+
+
+# ------------------------------------------------ bit-packed tile variant
+def _kernel_packed(nc: int, layout: PackedLayout, chunk: int, cid_ref,
+                   ttok_ref, tlen_ref, tdollar_ref, plo_ref, phi_ref,
+                   rows_hbm, out_ref):
+    """The wave kernel over BIT-PACKED tiles (pack_device_rows_packed):
+    ``rows_hbm`` is flat ``[up_chunks, groups*CHUNK]`` int32 — four byte
+    planes per lane — so each wave DMAs ``groups*CHUNK*4`` bytes per topic
+    instead of the legacy ``(L+3)*CHUNK*2``: the same ≥2× HBM-traffic
+    reduction the roofline models, in the kernel that is measured
+    HBM-bandwidth-bound. Byte planes unpack with static shifts/masks on
+    int32 vectors (no int8 vregs anywhere — Mosaic int8 arithmetic support
+    is not something this kernel wants to depend on); everything downstream
+    of the unpack (mask math in int32, MXU bit-pack via the f32 selector
+    matmuls, chunk-major stores) is identical to ``_kernel``."""
+    lanes = layout.groups * chunk
+    offs = layout.plane_offsets()
+    meta_p = layout.planes - 1
+
+    def body(scratch, sems):
+        def start_wave(slot, k):
+            for t in range(BT):
+                pltpu.make_async_copy(
+                    rows_hbm.at[cid_ref[t, k]], scratch.at[slot, t],
+                    sems.at[slot, t],
+                ).start()
+
+        def wait_wave(slot, k):
+            for t in range(BT):
+                pltpu.make_async_copy(
+                    rows_hbm.at[cid_ref[t, k]], scratch.at[slot, t],
+                    sems.at[slot, t],
+                ).wait()
+
+        start_wave(0, 0)
+
+        def step(k, _):
+            slot = k % 2
+
+            @pl.when(k + 1 < nc)
+            def _():
+                start_wave((k + 1) % 2, k + 1)
+
+            wait_wave(slot, k)
+            tiles = scratch[slot]  # [BT, groups*CHUNK] int32
+
+            def plane(p):
+                # byte plane p: static lane slice + static shift/mask
+                grp, sh = p // 4, (p % 4) * 8
+                x = tiles[:, grp * chunk : (grp + 1) * chunk]
+                if sh:
+                    x = x >> sh
+                return x & 0xFF
+
+            meta = plane(meta_p)
+            flen = (meta & 31) - 1  # empty rows encode flen+1 = 0
+            hh = (meta >> 5) & 1
+            fw = (meta >> 6) & 1
+            plen = flen - hh
+            bad = jnp.zeros((BT, chunk), jnp.int32)
+            for i, w in enumerate(layout.widths):
+                f = plane(offs[i])
+                if w == 2:
+                    f = f + (plane(offs[i] + 1) << 8)  # disjoint bytes: + == |
+                tt = ttok_ref[:, i : i + 1]  # [BT, 1] lane-broadcast
+                e = (
+                    jnp.where(f == tt, 1, 0)
+                    + jnp.where(f == PLUS_TOK, 1, 0)
+                    + jnp.where(plen <= i, 1, 0)
+                )
+                bad = bad + jnp.where(e == 0, 1, 0)
+            tl = tlen_ref[:, 0:1]  # [BT, 1]
+            ge = jnp.where(tl >= plen, 1, 0)
+            eqlen = jnp.where(tl == flen, 1, 0)
+            len_ok = hh * ge + (1 - hh) * eqlen
+            dollar_bad = tdollar_ref[:, 0:1] * fw
+            m32 = jnp.where(bad == 0, 1, 0) * len_ok * (1 - dollar_bad)
+            # MXU bit-pack: same two exact-f32 selector matmuls as _kernel
+            mf = m32.astype(jnp.float32)
+            dims = (((1,), (0,)), ((), ()))
+            wlo = lax.dot_general(mf, plo_ref[...], dims,
+                                  preferred_element_type=jnp.float32)
+            whi = lax.dot_general(mf, phi_ref[...], dims,
+                                  preferred_element_type=jnp.float32)
+            words = wlo.astype(jnp.int32) + (whi.astype(jnp.int32) << 16)
+            out_ref[pl.ds(k * BT, BT), :] = lax.bitcast_convert_type(
+                words, jnp.uint32
+            )
+
+        lax.fori_loop(0, nc, step, None)
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((2, BT, lanes), jnp.int32),
+        sems=pltpu.SemaphoreType.DMA((2, BT)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "interpret"))
+def match_words_pallas_packed(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                              layout: PackedLayout, interpret: bool = False):
+    """→ packed match words [B, NC*WPC] uint32 over bit-packed tiles
+    (B must be a multiple of BT). Same semantics as ``match_words_pallas``
+    and the lax ``scan_words_packed_impl`` — `PartitionedMatcher` verifies
+    that on-device at first use and falls back if anything disagrees."""
+    b, nc = chunk_ids.shape
+    lanes = packed_rows.shape[1]
+    chunk = lanes // layout.groups
+    wpc = chunk // 32
+    nlvl = layout.nlvl
+    kernel = functools.partial(_kernel_packed, nc, layout, chunk)
+    c = np.arange(chunk)
+    sel = (c[:, None] // 32) == np.arange(wpc)[None, :]
+    pos = c[:, None] % 32
+    plo = np.where(sel & (pos < 16), 2.0**pos, 0.0).astype(np.float32)
+    phi = np.where(sel & (pos >= 16), 2.0 ** (pos - 16), 0.0).astype(np.float32)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b // BT,),
+        in_specs=[
+            pl.BlockSpec((BT, nc), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BT, nlvl), lambda i: (i, 0)),  # VMEM: lane-broadcast
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
+            pl.BlockSpec((chunk, wpc), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # packed_rows stays in HBM
+        ],
+        out_specs=pl.BlockSpec((nc * BT, wpc), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b // BT * nc * BT, wpc), jnp.uint32),
+        interpret=interpret,
+    )(
+        chunk_ids.astype(jnp.int32),
+        ttok.astype(jnp.int32),
+        tlen.astype(jnp.int32).reshape(b, 1),
+        tdollar.astype(jnp.int32).reshape(b, 1),
+        plo,
+        phi,
+        packed_rows,
+    )
     return (
         out.reshape(b // BT, nc, BT, wpc)
         .transpose(0, 2, 1, 3)

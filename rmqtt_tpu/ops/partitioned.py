@@ -50,11 +50,16 @@ from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
 from rmqtt_tpu.ops.encode import (
     _FIRST_TOK,
     HASH_TOK,
+    PACKED_MAX_LEVELS,
+    PACKED_W1_MAX,
+    PACKED_W2_MAX,
     PAD_TOK,
     PLUS_TOK,
     DeltaLog,
+    PackedLayout,
     TokenDict,
     UNK_TOK,
+    group_byte_planes,
 )
 from rmqtt_tpu.utils.devfetch import fetch
 
@@ -318,6 +323,82 @@ class PartitionedTable:
         # signature flips at most once each
         self._tok_wide = False
         self._cand_wide = False
+        # --- bit-packed tile support (level-local token id spaces).
+        # Every (level, global id) pair a filter row uses is assigned a
+        # LOCAL id at write time; per-level LUT arrays translate global →
+        # local for both tile packing and topic encode. Widths are sticky
+        # grow-only (1 byte while a level's vocab fits 252 tokens, then 2);
+        # a level past 65532 tokens disables the packed format for good.
+        self.packed_ok = True
+        self._lvl_counts: List[int] = [0] * max_levels
+        self._lvl_widths: List[int] = [1] * max_levels
+        self._lvl_luts: List[np.ndarray] = [
+            self._new_lut() for _ in range(max_levels)
+        ]
+        # grow-only count of levels that carry token information (max
+        # prefix_len over live rows); compaction recomputes the true max
+        self._eff_levels = 1
+
+    @staticmethod
+    def _new_lut(cap: int = 1024) -> np.ndarray:
+        lut = np.full((cap,), UNK_TOK, dtype=np.int32)
+        lut[:_FIRST_TOK] = np.arange(_FIRST_TOK)  # reserved ids map to selves
+        return lut
+
+    def _register_level(self, level: int, gid: int) -> None:
+        """Assign (level, global id) its local id on first use. Caller holds
+        the table lock (all row writes do)."""
+        if gid < _FIRST_TOK:
+            return
+        lut = self._lvl_luts[level]
+        if gid >= len(lut):
+            cap = len(lut)
+            while cap <= gid:
+                cap *= 2
+            grown = np.full((cap,), UNK_TOK, dtype=np.int32)
+            grown[: len(lut)] = lut
+            self._lvl_luts[level] = lut = grown
+        if lut[gid] != UNK_TOK:
+            return
+        n = self._lvl_counts[level] + 1
+        self._lvl_counts[level] = n
+        lut[gid] = _FIRST_TOK - 1 + n
+        if n > PACKED_W1_MAX:
+            self._lvl_widths[level] = 2
+        if n > PACKED_W2_MAX:
+            self.packed_ok = False
+
+    def packed_layout(self) -> Optional[PackedLayout]:
+        """Static descriptor of the current bit-packed tile layout, or None
+        when the table is not packable (too-deep filters / a level's vocab
+        past two bytes). Compared by VALUE: any width/depth change yields a
+        different layout, which the delta-upload gate treats as a wholesale
+        relayout (full re-upload)."""
+        if not self.packed_ok or self.max_levels > PACKED_MAX_LEVELS:
+            return None
+        eff = min(max(self._eff_levels, 1), self.max_levels)
+        return PackedLayout(tuple(self._lvl_widths[:eff]))
+
+    def translate_packed(self, ttok: np.ndarray):
+        """→ ``(layout, ttok_local [B, layout.nlvl] int32)`` — topic tokens
+        re-keyed into the per-level local id spaces (unknown-at-level →
+        ``UNK_TOK``, which is exactly right: no filter row carries that
+        token at that level, so only wildcards can match it). Returns
+        ``(None, None)`` when the table is not packable. Runs under the
+        table lock so the layout and LUT contents are captured together."""
+        with self._mu:
+            layout = self.packed_layout()
+            if layout is None:
+                return None, None
+            nlvl = layout.nlvl
+            out = np.empty((ttok.shape[0], nlvl), dtype=np.int32)
+            for i in range(nlvl):
+                lut = self._lvl_luts[i]
+                g = ttok[:, i].astype(np.int64, copy=False)
+                out[:, i] = np.where(
+                    g < len(lut), lut[np.minimum(g, len(lut) - 1)], UNK_TOK
+                )
+            return layout, out
 
     def _tok_dtype(self):
         if not self._tok_wide and _FIRST_TOK + len(self.tokens) >= 0x7FFF:
@@ -349,6 +430,10 @@ class PartitionedTable:
                self._fid_of_row)
         old_rows, old_lvl = self._cap_chunks * CHUNK, self.max_levels
         self._cap_chunks, self.max_levels = new_cap, new_lvl
+        for _ in range(old_lvl, new_lvl):
+            self._lvl_counts.append(0)
+            self._lvl_widths.append(1)
+            self._lvl_luts.append(self._new_lut())
         self._alloc(new_cap, new_lvl)
         self._fid_of_row = np.full(new_cap * CHUNK, -1, dtype=np.int64)
         self.tok[:old_rows, :old_lvl] = old[0]
@@ -565,13 +650,18 @@ class PartitionedTable:
             elif lev == HASH:
                 tok_row[i] = HASH_TOK
             else:
-                tok_row[i] = self.tokens.intern(lev)
+                gid = self.tokens.intern(lev)
+                tok_row[i] = gid
+                self._register_level(i, gid)
         nlev = len(levels)
         hh = levels[-1] == HASH
         self.flen[row] = nlev
         self.prefix_len[row] = nlev - 1 if hh else nlev
         self.has_hash[row] = hh
         self.first_wild[row] = levels[0] in (PLUS, HASH)
+        prefix = nlev - 1 if hh else nlev
+        if prefix > self._eff_levels:
+            self._eff_levels = prefix
 
     def remove(self, fid: int) -> None:
         with self._mu:
@@ -752,6 +842,13 @@ class PartitionedTable:
             row = self._row_of_fid.get(fid)
             if row is not None and kl is not None:
                 self._write_row(row, kl[1])  # heal a possibly-torn copy
+        # compaction is the one point where _eff_levels may legally SHRINK
+        # (it is grow-only between compactions): the install already forces
+        # every mirror down the full-upload path, so a narrower packed
+        # layout costs nothing extra here
+        rows = self.nchunks * CHUNK
+        live = self.prefix_len[:rows][self._fid_of_row[:rows] >= 0]
+        self._eff_levels = max(1, int(live.max())) if live.size else 1
         # epoch bump + invalidations land in the same locked region, so
         # matchers can never pair stale chunk ids with the new device table
         self.dirty_ops = len(journal)
@@ -1039,6 +1136,91 @@ def scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids):
     return jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
 
 
+def _packed_plane(tile, k: int):
+    """Byte plane ``k`` of a flat packed tile ``[.., groups*CHUNK]`` int32
+    (four planes per lane, little-endian; see pack_device_rows_packed)."""
+    grp, sh = k // 4, (k % 4) * 8
+    x = tile[..., grp * CHUNK : (grp + 1) * CHUNK]
+    if sh:
+        x = x >> sh
+    return x & 0xFF
+
+
+def scan_words_packed_impl(packed32, ttok, tlen, tdollar, chunk_ids, *,
+                           layout: PackedLayout):
+    """``scan_words_impl`` over BIT-PACKED tiles → packed words
+    ``[B, NC*WPC]`` uint32, bitwise identical to the legacy path on the
+    same table state (the interp-mode property tests pin this).
+
+    ``packed32`` is the flat ``[up_chunks, groups*CHUNK]`` int32 array
+    (``pack_device_rows_packed``); ``ttok`` carries LEVEL-LOCAL token ids
+    (``PartitionedTable.translate_packed``), so each level compares against
+    its own ≤2-byte id space. Levels beyond ``layout.nlvl`` are omitted
+    entirely — every live row's prefix ends at or before ``nlvl`` (grow-only
+    ``_eff_levels``), so those comparisons are always-true ``beyond`` terms
+    in the legacy formula. The per-step gather shrinks from
+    ``(L+3)*CHUNK*2`` bytes to ``groups*CHUNK*4`` — the bytes-moved
+    reduction ``scripts/roofline.py`` models."""
+    b, nc = chunk_ids.shape
+    ttok = ttok.astype(jnp.int32)
+    tlen = tlen.astype(jnp.int32)
+    chunk_ids = chunk_ids.astype(jnp.int32)
+    bit = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+    offs = layout.plane_offsets()
+    meta_p = layout.planes - 1
+
+    def body(_, cid):  # cid: [B]
+        g = packed32[cid]  # [B, G*CHUNK] single tile gather
+        meta = _packed_plane(g, meta_p)
+        flen_g = (meta & 31) - 1  # empty rows encode flen+1 = 0
+        hh_g = (meta >> 5) & 1
+        fw_g = (meta >> 6) & 1
+        pl_g = flen_g - hh_g
+        ok = jnp.ones((b, CHUNK), dtype=jnp.bool_)
+        for i, w in enumerate(layout.widths):
+            f = _packed_plane(g, offs[i])
+            if w == 2:
+                f = f | (_packed_plane(g, offs[i] + 1) << 8)
+            eq = f == ttok[:, i, None]
+            plus = f == PLUS_TOK
+            beyond = pl_g <= i
+            ok = ok & (eq | plus | beyond)
+        len_ok = jnp.where(hh_g == 1, tlen[:, None] >= pl_g,
+                           tlen[:, None] == flen_g)
+        dollar_ok = jnp.logical_not(tdollar[:, None] & (fw_g == 1))
+        m = ok & len_ok & dollar_ok
+        packed = jnp.sum(
+            m.reshape(b, WORDS_PER_CHUNK, 32).astype(jnp.uint32) * bit[None, None, :],
+            axis=-1,
+            dtype=jnp.uint32,
+        )
+        return None, packed  # [B, WPC]
+
+    _, words = lax.scan(body, None, jnp.moveaxis(chunk_ids, 0, 1))
+    return jnp.moveaxis(words, 0, 1).reshape(b, nc * WORDS_PER_CHUNK)
+
+
+def words_any_impl(tiles, ttok, tlen, tdollar, chunk_ids, *, layout=None,
+                   use_pallas: bool = False, interpret: bool = False):
+    """The one words-producer seam: legacy or packed tiles × lax scan or
+    Pallas wave kernel, all statically selected so every combination traces
+    into a single dispatch when embedded in a larger jit."""
+    if use_pallas:
+        if layout is None:
+            from rmqtt_tpu.ops.pallas_match import match_words_pallas
+
+            return match_words_pallas(tiles, ttok, tlen, tdollar, chunk_ids,
+                                      interpret=interpret)
+        from rmqtt_tpu.ops.pallas_match import match_words_pallas_packed
+
+        return match_words_pallas_packed(tiles, ttok, tlen, tdollar, chunk_ids,
+                                         layout=layout, interpret=interpret)
+    if layout is None:
+        return scan_words_impl(tiles, ttok, tlen, tdollar, chunk_ids)
+    return scan_words_packed_impl(tiles, ttok, tlen, tdollar, chunk_ids,
+                                  layout=layout)
+
+
 def compact_global_impl(words, budget: int):
     """Packed words [B, W] → batch-global ROUTE-level compaction.
 
@@ -1101,23 +1283,26 @@ def compact_global_impl(words, budget: int):
     return jnp.concatenate([routes, cnts.astype(rdt)])
 
 
-def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int):
+def match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget: int,
+                      layout=None):
     """Gather-based partitioned match → global-compact packed [budget+B]."""
-    words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
+    words = words_any_impl(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                           layout=layout)
     return compact_global_impl(words, budget)
 
 
 def match_global_grouped_impl(packed_rows, ttok, tlen, tdollar, uniq_cand, inv,
-                              budget: int):
+                              budget: int, layout=None):
     """Global match with DEDUPLICATED candidate rows: upload [U, NC] distinct
     rows + a [B] inverse instead of [B, NC] (zipf publish streams share a
     few hot prefixes across the whole batch); the full per-topic chunk-id
     matrix is rebuilt by one device gather."""
     chunk_ids = uniq_cand[inv.astype(jnp.int32)]
-    return match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, budget)
+    return match_global_impl(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                             budget, layout)
 
 
-def match_global_split_impl(packed_rows, parts, budgets):
+def match_global_split_impl(packed_rows, parts, budgets, layout=None):
     """NC split-dispatch: the scan costs B×NC tile gathers, but measured
     batches average ~7 candidate chunks against an NC=32 pad — most of the
     device compute was padding (NOTES.md). Topics are bucketed host-side by
@@ -1132,7 +1317,7 @@ def match_global_split_impl(packed_rows, parts, budgets):
     (a bucket's segment is ``[routes(budget_b)..., cnts(padded_b)...]``).
     """
     outs = [
-        match_global_impl(packed_rows, *p, budget=g)
+        match_global_impl(packed_rows, *p, budget=g, layout=layout)
         for p, g in zip(parts, budgets)
     ]
     dt = (jnp.uint32 if any(o.dtype == jnp.uint32 for o in outs)
@@ -1140,13 +1325,121 @@ def match_global_split_impl(packed_rows, parts, budgets):
     return jnp.concatenate([o.astype(dt) for o in outs])
 
 
+# ------------------------------------------------- fused device pipeline
+def fused_compact_decode_impl(words, fid_rows, chunk_ids, budget: int):
+    """Packed words → final per-topic FID buffer, entirely on device: the
+    fused tail that replaces ``compact_global_impl`` + the host decode.
+
+    Same two prefix-sum stages as ``compact_global_impl``, but each route
+    slot additionally remembers its TOPIC (scattered alongside the word
+    index in stage 1), so stage 2 can compute the matched row's GLOBAL id
+    ``chunk_ids[topic, widx//WPC]*CHUNK + (widx%WPC)*32 + bitpos`` and
+    resolve it through the device-resident row→fid map — the indirection
+    the host decode used to perform per route. A final two-key
+    ``lax.sort`` over (topic, fid) puts the buffer in exactly the order
+    the router contract wants (flat topic-major, fids ascending per
+    topic), so the host's whole job is one ``np.split`` by counts.
+
+    Unfilled slots carry the sentinel topic ``b`` (sorts after every real
+    topic) — the host only reads ``cnts.sum()`` slots, which the sort
+    packs to the front. Overflow stays detectable exactly as before:
+    counts come from the words' popcount, independent of the slot budget.
+
+    Wire: ``[budget + B]`` int32 ``[fids..., cnts...]`` — 4 B/route vs the
+    unfused path's 2 B, bought back severalfold by eliminating the second
+    dispatch and the host-side chunk-gather + fid-map + sort (the p99
+    share cfg11 attributes)."""
+    b, w = words.shape
+    wpc = WORDS_PER_CHUNK
+    chunk_ids = chunk_ids.astype(jnp.int32)
+    fid_flat = fid_rows.reshape(-1)
+    flat = words.ravel()
+    nz = flat != jnp.uint32(0)
+    nzi = nz.astype(jnp.int32)
+    pos = jnp.cumsum(nzi) - nzi
+    # sentinel index == budget → OOB-dropped (see compact_global_impl on
+    # why these scatters must not claim unique indices)
+    idx = jnp.where(nz & (pos < budget), pos, budget)
+    wsrc = lax.broadcasted_iota(jnp.int32, (b, w), 1).ravel()
+    tsrc = lax.broadcasted_iota(jnp.int32, (b, w), 0).ravel()
+    widx = jnp.zeros((budget,), jnp.int32).at[idx].set(wsrc, mode="drop")
+    wtop = jnp.zeros((budget,), jnp.int32).at[idx].set(tsrc, mode="drop")
+    bits = jnp.zeros((budget,), jnp.uint32).at[idx].set(flat, mode="drop")
+    # stage 2: expand compacted words' bits into fid slots. Unfilled word
+    # slots keep (widx=0, wtop=0) — their gathers stay in range and their
+    # lanes all carry zero bits, so every one of them is dropped.
+    bitm = (bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    rnzi = bitm.astype(jnp.int32).ravel()
+    rpos = jnp.cumsum(rnzi) - rnzi
+    ridx = jnp.where((rnzi > 0) & (rpos < budget), rpos, budget)
+    rows = (
+        chunk_ids[wtop, widx // wpc] * CHUNK + (widx % wpc) * 32
+    )[:, None] + jnp.arange(32, dtype=jnp.int32)[None, :]
+    fvals = fid_flat[rows.ravel()]
+    tvals = jnp.broadcast_to(wtop[:, None], (budget, 32)).ravel()
+    tj = jnp.full((budget,), b, jnp.int32).at[ridx].set(tvals, mode="drop")
+    fids = jnp.zeros((budget,), jnp.int32).at[ridx].set(fvals, mode="drop")
+    _tj_s, fid_s = lax.sort((tj, fids), num_keys=2)
+    cnts = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=1)
+    return jnp.concatenate([fid_s, cnts])
+
+
+def match_fused_impl(tiles, fid_rows, ttok, tlen, tdollar, chunk_ids,
+                     budget: int, layout=None, use_pallas: bool = False,
+                     interpret: bool = False):
+    """The fused dispatch: words (lax or Pallas, legacy or packed tiles) →
+    global compaction → on-device fid decode+sort, ONE jit call whose
+    output is the final ``[budget + B]`` int32 fid buffer. Nothing but
+    final fids and counts crosses the device→host tunnel."""
+    words = words_any_impl(tiles, ttok, tlen, tdollar, chunk_ids,
+                           layout=layout, use_pallas=use_pallas,
+                           interpret=interpret)
+    return fused_compact_decode_impl(words, fid_rows, chunk_ids, budget)
+
+
+def match_fused_grouped_impl(tiles, fid_rows, ttok, tlen, tdollar, uniq_cand,
+                             inv, budget: int, layout=None,
+                             use_pallas: bool = False,
+                             interpret: bool = False):
+    """Fused dispatch over the deduplicated candidate upload."""
+    chunk_ids = uniq_cand[inv.astype(jnp.int32)]
+    return match_fused_impl(tiles, fid_rows, ttok, tlen, tdollar, chunk_ids,
+                            budget, layout, use_pallas, interpret)
+
+
+def match_fused_split_impl(tiles, fid_rows, parts, budgets, layout=None):
+    """Fused NC split-dispatch: every bucket's fused output concatenates on
+    device — one dispatch, one fetch, zero host decode. Buckets are padded
+    to arbitrary pow2 sizes (often below the Pallas BT grid), so the split
+    form always uses the lax words producer."""
+    outs = [
+        match_fused_impl(tiles, fid_rows, *p, budget=g, layout=layout)
+        for p, g in zip(parts, budgets)
+    ]
+    return jnp.concatenate(outs)
+
+
 _match_global_split = jax.jit(match_global_split_impl,
-                              static_argnames=("budgets",))
+                              static_argnames=("budgets", "layout"))
 
 
-_match_global = jax.jit(match_global_impl, static_argnames=("budget",))
-_match_global_grouped = jax.jit(match_global_grouped_impl, static_argnames=("budget",))
+_match_global = jax.jit(match_global_impl, static_argnames=("budget", "layout"))
+_match_global_grouped = jax.jit(match_global_grouped_impl,
+                                static_argnames=("budget", "layout"))
 _compact_global = jax.jit(compact_global_impl, static_argnames=("budget",))
+_match_fused = jax.jit(match_fused_impl,
+                       static_argnames=("budget", "layout", "use_pallas",
+                                        "interpret"))
+_match_fused_grouped = jax.jit(match_fused_grouped_impl,
+                               static_argnames=("budget", "layout",
+                                                "use_pallas", "interpret"))
+_match_fused_split = jax.jit(match_fused_split_impl,
+                             static_argnames=("budgets", "layout"))
+#: standalone jitted Pallas words producer (the words+compact two-dispatch
+#: form the fused pipeline replaces; still used when fused is off)
+_jit_words_pallas = jax.jit(
+    functools.partial(words_any_impl, use_pallas=True),
+    static_argnames=("layout", "interpret"))
 
 # process-wide pallas verify+race outcome (None = not yet decided); each race
 # costs a full pallas compile, so every matcher in the process shares it
@@ -1156,6 +1449,17 @@ _PALLAS_RACED: Optional[bool] = None
 def _platform(dev) -> str:
     """Platform of a device array (single source for the decide paths)."""
     return next(iter(dev.devices())).platform if hasattr(dev, "devices") else ""
+
+
+def _pallas_bt() -> int:
+    """The Pallas wave width (import-guarded for environments without the
+    pallas extras)."""
+    try:
+        from rmqtt_tpu.ops.pallas_match import BT
+
+        return BT
+    except ImportError:  # pragma: no cover - depends on install
+        return 1 << 30  # never a divisor → pallas never selected
 
 
 def compact_words_impl(words, max_words: int):
@@ -1170,13 +1474,16 @@ def compact_words_impl(words, max_words: int):
     return word_idx, word_bits, counts
 
 
-def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids, max_words: int):
+def match_partitioned_impl(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                           max_words: int, layout=None):
     """Gather-based partitioned match → (word_idx, word_bits, counts)."""
-    words = scan_words_impl(packed_rows, ttok, tlen, tdollar, chunk_ids)
+    words = words_any_impl(packed_rows, ttok, tlen, tdollar, chunk_ids,
+                           layout=layout)
     return compact_words_impl(words, max_words)
 
 
-_match_partitioned = jax.jit(match_partitioned_impl, static_argnames=("max_words",))
+_match_partitioned = jax.jit(match_partitioned_impl,
+                             static_argnames=("max_words", "layout"))
 _compact_words = jax.jit(compact_words_impl, static_argnames=("max_words",))
 
 
@@ -1202,15 +1509,7 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     halves again, and int16 compares run at twice the VPU lane density.
     flen/prefix_len (≤ L+1) and the 2-bit flags always fit.
     """
-    if t.nchunks <= 16384:
-        up_chunks = max(64, 1 << (t.nchunks - 1).bit_length())
-    else:
-        # pow2 padding wastes up to half the array exactly where tables are
-        # huge (10M subs ≈ 83K chunks → a 131072 pad = 200MB of zero tiles,
-        # round 2's cfg4 compile-failure regime); above 16K chunks pad to a
-        # multiple of 4096 instead — at most one recompile per 4096-chunk
-        # growth, amortized at that scale
-        up_chunks = (t.nchunks + 4095) // 4096 * 4096
+    up_chunks = _pad_chunk_count(t.nchunks)
     rows = t.nchunks * CHUNK
     lvl = t.max_levels
     dt = np.int32 if t._tok_wide else np.int16
@@ -1224,6 +1523,110 @@ def pack_device_rows(t: PartitionedTable) -> np.ndarray:
     return np.ascontiguousarray(
         packed.reshape(-1, CHUNK, lvl + 3).transpose(0, 2, 1)
     )
+
+
+def _pad_chunk_count(nchunks: int) -> int:
+    """Padded device chunk count: pow2 (floor 64) up to 16K chunks so table
+    growth recompiles the kernel at most once per bucket; above that pow2
+    padding wastes up to half the array exactly where tables are huge (10M
+    subs ≈ 83K chunks → a 131072 pad = 200MB of zero tiles, round 2's cfg4
+    compile-failure regime), so pad to a multiple of 4096 instead."""
+    if nchunks <= 16384:
+        return max(64, 1 << (nchunks - 1).bit_length())
+    return (nchunks + 4095) // 4096 * 4096
+
+
+def _byte_planes_for_rows(t: PartitionedTable, layout: PackedLayout, rows):
+    """→ ``[n, layout.planes] uint8`` byte planes for the given physical
+    rows (slice or index array): per-level LOCAL token ids (low byte, then
+    the optional high byte) followed by the metadata byte
+    ``flen+1 | has_hash<<5 | first_wild<<6`` (empty rows encode flen+1 = 0;
+    ``prefix_len`` is derivable as ``flen - has_hash`` and not stored)."""
+    tok = t.tok[rows]
+    flen = t.flen[rows]
+    hh = t.has_hash[rows]
+    fw = t.first_wild[rows]
+    planes = np.zeros((len(flen), layout.planes), dtype=np.uint8)
+    p = 0
+    for i, w in enumerate(layout.widths):
+        lut = t._lvl_luts[i]
+        g = tok[:, i].astype(np.int64, copy=False)
+        loc = np.where(g < len(lut), lut[np.minimum(g, len(lut) - 1)], UNK_TOK)
+        planes[:, p] = loc & 0xFF
+        p += 1
+        if w == 2:
+            planes[:, p] = (loc >> 8) & 0xFF
+            p += 1
+    meta = np.where(flen < 0, 0, flen + 1).astype(np.int64)
+    meta = meta | (hh.astype(np.int64) << 5) | (fw.astype(np.int64) << 6)
+    planes[:, p] = meta
+    return planes
+
+
+def pack_device_rows_packed(t: PartitionedTable, layout: PackedLayout) -> np.ndarray:
+    """Bit-packed device mirror: flat ``[up_chunks, groups*CHUNK]`` int32 —
+    four byte planes per int32 lane (encode.group_byte_planes), chunk c's
+    plane g occupying lanes ``[g*CHUNK, (g+1)*CHUNK)`` of row c. The flat
+    2D shape is deliberate: the minor dim is a 128 multiple (Pallas DMA
+    alignment) and the sublane dim is the chunk count, so the array carries
+    NO tile-padding waste — unlike a 3D int8 ``[.., planes, CHUNK]`` layout,
+    whose 9→32 sublane pad would triple the resident bytes and erase the
+    packing win. Per-chunk gather traffic drops from ``(L+3)*CHUNK*2`` bytes
+    (legacy int16 field-major) to ``groups*CHUNK*4`` — 2816 → 1024 B at the
+    bench's mixed-wildcard shape (L=8, six 1-byte levels + one 2-byte), the
+    ≥2× HBM reduction scripts/roofline.py models. Padding chunks are zeros
+    (flen+1 = 0 ⇒ empty), rejected for every topic."""
+    up_chunks = _pad_chunk_count(t.nchunks)
+    rows = t.nchunks * CHUNK
+    planes = _byte_planes_for_rows(t, layout, slice(0, rows))
+    arr32 = group_byte_planes(planes, layout.groups)
+    full = np.zeros((up_chunks * CHUNK, layout.groups), dtype=np.int32)
+    full[:rows] = arr32
+    return np.ascontiguousarray(
+        full.reshape(up_chunks, CHUNK, layout.groups)
+        .transpose(0, 2, 1)
+        .reshape(up_chunks, layout.groups * CHUNK)
+    )
+
+
+def pack_chunk_tiles_packed(
+    t: PartitionedTable, cids: Sequence[int], layout: PackedLayout
+) -> np.ndarray:
+    """Delta-upload payload for the packed format: only the given chunks,
+    same flat int32 lane layout as ``pack_device_rows_packed`` so tiles
+    scatter straight into the resident array by leading-axis index."""
+    k = len(cids)
+    cid_arr = np.asarray(cids, dtype=np.int64)
+    rows = (cid_arr[:, None] * CHUNK + np.arange(CHUNK, dtype=np.int64)).reshape(-1)
+    planes = _byte_planes_for_rows(t, layout, rows)
+    arr32 = group_byte_planes(planes, layout.groups)
+    return np.ascontiguousarray(
+        arr32.reshape(k, CHUNK, layout.groups)
+        .transpose(0, 2, 1)
+        .reshape(k, layout.groups * CHUNK)
+    )
+
+
+def pack_fid_rows(t: PartitionedTable) -> np.ndarray:
+    """Device-resident row→fid map ``[up_chunks, CHUNK]`` int32 (the fused
+    pipeline resolves matched rows to filter ids ON DEVICE, so only final
+    fids cross the tunnel). -1 marks empty rows; a -1 escaping through the
+    fused output means a cleared row matched — a device bug the host fails
+    loudly on, mirroring ``_group_sorted``'s contract. int32 bounds fids at
+    2^31 (4 billion ``add()`` calls), same practical bound the composite-
+    key host sort already enforces."""
+    up_chunks = _pad_chunk_count(t.nchunks)
+    rows = t.nchunks * CHUNK
+    out = np.full((up_chunks * CHUNK,), -1, dtype=np.int32)
+    out[:rows] = t._fid_of_row[:rows]
+    return out.reshape(up_chunks, CHUNK)
+
+
+def pack_fid_chunk_tiles(t: PartitionedTable, cids: Sequence[int]) -> np.ndarray:
+    """Dirty-chunk slices of the device fid map (delta refresh payload)."""
+    cid_arr = np.asarray(cids, dtype=np.int64)
+    rows = (cid_arr[:, None] * CHUNK + np.arange(CHUNK, dtype=np.int64)).reshape(-1)
+    return t._fid_of_row[rows].astype(np.int32).reshape(len(cids), CHUNK)
 
 
 def pack_chunk_tiles(t: PartitionedTable, cids: Sequence[int], dt) -> np.ndarray:
@@ -1249,12 +1652,15 @@ def pack_chunk_tiles(t: PartitionedTable, cids: Sequence[int], dt) -> np.ndarray
 
 def delta_chunk_plan(t: PartitionedTable, *, enabled: bool, dev_version: int,
                      has_resident: bool, dev_epoch: int, dev_lvl: int,
-                     dev_dtype, dt, dev_up_chunks: int):
+                     dev_dtype, dt, dev_up_chunks: int,
+                     dev_layout=None, layout=None):
     """The delta-refresh validity gate, shared by every chunk-tile mirror
     (local + mesh-replicated): → dirty chunk ids (possibly empty) when a
     scatter refresh is sound, else None (caller full-uploads). The gate is
     correctness-critical — a condition added here must hold for all
-    consumers, which is why it lives in one place."""
+    consumers, which is why it lives in one place. ``dev_layout``/``layout``
+    compare the resident vs current bit-packed tile layout (both None for
+    legacy tiles): any width/depth/format change is a wholesale relayout."""
     if (
         not enabled
         or dev_version < 0
@@ -1262,6 +1668,7 @@ def delta_chunk_plan(t: PartitionedTable, *, enabled: bool, dev_version: int,
         or dev_epoch != t.layout_epoch
         or dev_lvl != t.max_levels
         or dev_dtype != dt
+        or dev_layout != layout
         or t.nchunks > dev_up_chunks
     ):
         return None
@@ -1332,6 +1739,28 @@ class PartitionedMatcher:
         self._dev_arrays = None
         self._pallas: Optional[bool] = None  # None = not decided yet
         self._pallas_interpret = False  # CPU (tests): run the kernel interpreted
+        # --- fused match→compact→decode pipeline (RMQTT_FUSED=0/1 forces
+        # off/on; default verifies against the lax+host-decode reference on
+        # the first global-mode batch and falls back if anything disagrees —
+        # same contract as the Pallas kernel: an unverified fused path must
+        # never change routing results). Requires 'global' compact mode.
+        env_fused = os.environ.get("RMQTT_FUSED", "")
+        self._fused: Optional[bool] = (
+            False if env_fused == "0" or self.compact_mode != "global"
+            else (True if env_fused == "1" else None)
+        )
+        self.fused_batches = 0  # batches served end-to-end on device
+        # --- bit-packed tiles (RMQTT_PACKED=0 restores legacy int16/int32
+        # field-major tiles); engages per refresh iff the table is packable
+        self._packed_pref = os.environ.get("RMQTT_PACKED", "1") != "0"
+        self._dev_playout = None  # PackedLayout of the resident tiles (None = legacy)
+        self._dev_fids = None  # device row→fid map [up_chunks, CHUNK] int32
+        # sticky small-batch pad floor (prewarm): tiny batches pad UP to one
+        # already-compiled shape instead of compiling shapes 1/2/4/... each
+        self._pad_floor = 1
+        # per-stage wall-clock attribution (cfg11): zero-overhead when off
+        self.stage_timing = False
+        self.stage_ns = {"encode": 0, "dispatch": 0, "fetch": 0, "decode": 0}
         # segmented-table mode: device tables above this byte budget split
         # into multiple arrays scanned per segment (one huge device_put +
         # compile at 10M subs is round 2's undiagnosed cfg4 on-chip failure;
@@ -1373,15 +1802,25 @@ class PartitionedMatcher:
             return _PALLAS_RACED
         log = _LOG
         try:
-            from rmqtt_tpu.ops.pallas_match import match_words_pallas
-
+            layout = self._dev_playout
             self._pallas_interpret = platform != "tpu"
+
+            def match_words_pallas(dev, ttok, tlen, tdollar, chunk_ids):
+                # the kernel variant matching the RESIDENT tile format
+                return words_any_impl(
+                    dev, ttok, tlen, tdollar, chunk_ids, layout=layout,
+                    use_pallas=True, interpret=self._pallas_interpret)
+
+            def scan_words_ref(dev, ttok, tlen, tdollar, chunk_ids):
+                return words_any_impl(dev, ttok, tlen, tdollar, chunk_ids,
+                                      layout=layout)
+
             got = fetch(
-                match_words_pallas(dev, ttok, tlen, tdollar, chunk_ids,
-                                   interpret=self._pallas_interpret),
+                jax.jit(match_words_pallas)(dev, ttok, tlen, tdollar,
+                                            chunk_ids),
                 "pallas verify fetch",
             )
-            lax_fn = jax.jit(scan_words_impl)
+            lax_fn = jax.jit(scan_words_ref)
             want = fetch(lax_fn(dev, ttok, tlen, tdollar, chunk_ids),
                          "lax verify fetch")
             if not np.array_equal(got, want):
@@ -1405,7 +1844,7 @@ class PartitionedMatcher:
                     return (time.perf_counter() - t0) / reps
 
                 t_pallas = clock(match_words_pallas)
-                t_lax = clock(scan_words_impl)
+                t_lax = clock(scan_words_ref)
                 _PALLAS_RACED = bool(t_pallas < t_lax)
                 log.info(
                     "pallas match kernel verified; %s (%.1fms vs lax %.1fms)",
@@ -1420,40 +1859,43 @@ class PartitionedMatcher:
                 _PALLAS_RACED = False
             return False
 
+    def _maybe_decide_pallas(self, dev, ttok, tlen, tdollar, chunk_ids) -> None:
+        """Run the pallas verify+race decision if this batch qualifies
+        (shared by the words-then-compact path and the fused pipeline;
+        _pallas_bt() keeps installs without the pallas extras on lax)."""
+        if self._pallas is not None or chunk_ids.shape[0] % _pallas_bt():
+            return
+        env = os.environ.get("RMQTT_PALLAS", "")
+        if (env not in ("0", "1") and _PALLAS_RACED is None
+                and chunk_ids.shape[0] < 1024 and _platform(dev) == "tpu"):
+            # the verify+race decision latches for the process lifetime:
+            # deciding on an unrepresentative tiny batch (a broker's
+            # first match is often ONE topic, padded to BT) would let
+            # per-call overhead disqualify the kernel for the large-batch
+            # regime it was built for — stay on lax until a real batch.
+            # Every OTHER undecided case (non-TPU, forced env, settled
+            # race) resolves compile-free inside _decide_pallas, so
+            # small-batch-only processes still latch and stop BT padding
+            return
+        try:
+            self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar,
+                                               chunk_ids)
+        except Exception as e:
+            # any decide-path surprise (e.g. a wedged backend raising
+            # from dev.devices()) degrades to lax, never crashes the
+            # match path
+            _LOG.warning(
+                "pallas decide path failed (%s); using lax path", e)
+            self._pallas = False
+
     def _words(self, dev, ttok, tlen, tdollar, chunk_ids):
-        from rmqtt_tpu.ops.pallas_match import BT
-
-        if chunk_ids.shape[0] % BT:
+        if chunk_ids.shape[0] % _pallas_bt():
             return None  # pallas grid needs a BT-multiple batch
-        if self._pallas is None:
-            env = os.environ.get("RMQTT_PALLAS", "")
-            if (env not in ("0", "1") and _PALLAS_RACED is None
-                    and chunk_ids.shape[0] < 1024 and _platform(dev) == "tpu"):
-                # the verify+race decision latches for the process lifetime:
-                # deciding on an unrepresentative tiny batch (a broker's
-                # first match is often ONE topic, padded to BT) would let
-                # per-call overhead disqualify the kernel for the large-batch
-                # regime it was built for — stay on lax until a real batch.
-                # Every OTHER undecided case (non-TPU, forced env, settled
-                # race) resolves compile-free inside _decide_pallas, so
-                # small-batch-only processes still latch and stop BT padding
-                return None
-            try:
-                self._pallas = self._decide_pallas(dev, ttok, tlen, tdollar,
-                                                   chunk_ids)
-            except Exception as e:
-                # any decide-path surprise (e.g. a wedged backend raising
-                # from dev.devices()) degrades to lax, never crashes the
-                # match path
-                _LOG.warning(
-                    "pallas decide path failed (%s); using lax path", e)
-                self._pallas = False
+        self._maybe_decide_pallas(dev, ttok, tlen, tdollar, chunk_ids)
         if self._pallas:
-            from rmqtt_tpu.ops.pallas_match import match_words_pallas
-
-            return match_words_pallas(
+            return _jit_words_pallas(
                 dev, ttok, tlen, tdollar, chunk_ids,
-                interpret=self._pallas_interpret,
+                layout=self._dev_playout, interpret=self._pallas_interpret,
             )
         return None
 
@@ -1472,8 +1914,16 @@ class PartitionedMatcher:
                 self._dev_arrays is not None or self._segments is not None
             ):
                 return self._dev_arrays
-            dt = np.int32 if t._tok_wide else np.int16
-            if self._try_delta_refresh(t, dt):
+            # tile format: bit-packed while the table is packable (and not
+            # opted out); the packed device array is int32 (grouped byte
+            # planes), so the layout token — not the dtype — is what the
+            # delta gate compares for relayout detection
+            layout = t.packed_layout() if self._packed_pref else None
+            if layout is not None:
+                dt = np.int32
+            else:
+                dt = np.int32 if t._tok_wide else np.int16
+            if self._try_delta_refresh(t, dt, layout):
                 return self._dev_arrays
             # full path: repack + re-upload everything (first refresh,
             # layout change, dtype widening, growth past the resident
@@ -1483,7 +1933,9 @@ class PartitionedMatcher:
             # multi-GB upload (the stall this PR removes); mutations that
             # land during the transfer stay pending because the version
             # installed is the one captured here.
-            packed = pack_device_rows(t)
+            packed = (pack_device_rows_packed(t, layout) if layout is not None
+                      else pack_device_rows(t))
+            fids2d = pack_fid_rows(t) if self._want_fids() else None
             version, epoch, lvl = t.version, t.layout_epoch, t.max_levels
             fid_map = t._fid_of_row
         put = (
@@ -1493,7 +1945,8 @@ class PartitionedMatcher:
         )
         if packed.nbytes > self._seg_bytes and self.compact_mode == "global":
             self._dev_arrays = None
-            self._segments = self._build_segments(packed, put)
+            self._dev_fids = None
+            self._segments = self._build_segments(packed, fids2d, put)
         else:
             if packed.nbytes > self._seg_bytes:
                 # only the 'global' wire format supports segment merge;
@@ -1506,11 +1959,40 @@ class PartitionedMatcher:
                     packed.nbytes >> 20, self.compact_mode,
                 )
             self._segments = None
-            self._dev_arrays = put(packed)
+            try:
+                self._dev_arrays = put(packed)
+                self._dev_fids = put(fids2d) if fids2d is not None else None
+            except Exception as e:
+                # oversize-table fail-soft (cfg4's "pre NC-split table"
+                # compile death): a failed whole-table upload retries as
+                # bounded segments instead of wedging the run; when the
+                # wire format cannot segment, fail with actionable sizing
+                # guidance rather than a bare backend error
+                if self.compact_mode != "global":
+                    raise RuntimeError(
+                        f"device table upload failed at {packed.nbytes >> 20}"
+                        f"MB ({t.nchunks} chunks, {t.size} filters) and "
+                        f"compact_mode={self.compact_mode!r} cannot use "
+                        "segmented tables; switch to RMQTT_COMPACT=global "
+                        "or lower the table size"
+                    ) from e
+                self._seg_bytes = max(
+                    64 << 20, min(self._seg_bytes, packed.nbytes // 4)
+                )
+                _LOG.warning(
+                    "whole-table device upload failed (%s: %s); retrying as "
+                    "segmented arrays at %dMB/segment (tune RMQTT_SEG_BYTES "
+                    "to pre-empt this)",
+                    type(e).__name__, e, self._seg_bytes >> 20,
+                )
+                self._dev_arrays = None
+                self._dev_fids = None
+                self._segments = self._build_segments(packed, fids2d, put)
         self._dev_version = version
         self._dev_epoch = epoch
         self._dev_lvl = lvl
         self._dev_dtype = dt
+        self._dev_playout = layout
         self._dev_up_chunks = (
             packed.shape[0] if self._segments is None
             else self._seg_cap * len(self._segments)
@@ -1518,46 +2000,78 @@ class PartitionedMatcher:
         self._dev_fid_map = fid_map
         self.uploads += 1
         self.full_uploads += 1
-        self.upload_bytes += packed.nbytes
+        self.upload_bytes += packed.nbytes + (
+            fids2d.nbytes if fids2d is not None else 0)
         return self._dev_arrays
 
-    def _try_delta_refresh(self, t: PartitionedTable, dt) -> bool:
+    def _want_fids(self) -> bool:
+        """Device fid rows are packed/uploaded only while the fused
+        pipeline can serve batches (global mode, not ruled out)."""
+        return self._fused is not False and self.compact_mode == "global"
+
+    def _try_delta_refresh(self, t: PartitionedTable, dt, layout) -> bool:
         """Scatter-write only the dirty chunks into the resident device
-        array(s). Possible iff the layout epoch, row width, tile dtype and
-        padded capacity all still match the resident snapshot; otherwise
-        (or when the delta journal overflowed) the caller full-uploads."""
+        array(s). Possible iff the layout epoch, row width, tile dtype,
+        packed-tile layout and padded capacity all still match the resident
+        snapshot; otherwise (or when the delta journal overflowed) the
+        caller full-uploads."""
         cids = delta_chunk_plan(
             t, enabled=self.delta_enabled, dev_version=self._dev_version,
             has_resident=self._dev_arrays is not None or self._segments is not None,
             dev_epoch=self._dev_epoch, dev_lvl=self._dev_lvl,
             dev_dtype=self._dev_dtype, dt=dt, dev_up_chunks=self._dev_up_chunks,
+            dev_layout=self._dev_playout, layout=layout,
         )
         if cids is None:
             return False
+        want_fids = self._want_fids()
+        has_fids = (
+            self._dev_fids is not None if self._segments is None
+            else all(s[3] is not None for s in self._segments)
+        )
+        if want_fids and not has_fids:
+            return False  # fused newly wants fid rows: full upload builds them
+        if not want_fids and self._dev_fids is not None:
+            # fused ruled out after the fid map went resident: drop it so
+            # delta refreshes stop packing/shipping tiles nothing reads
+            self._dev_fids = None
+            has_fids = False
         if cids:
-            tiles = pack_chunk_tiles(t, cids, dt)
+            tiles = (pack_chunk_tiles_packed(t, cids, layout)
+                     if layout is not None else pack_chunk_tiles(t, cids, dt))
+            ftiles = (pack_fid_chunk_tiles(t, cids)
+                      if has_fids and want_fids else None)
             if self._segments is None:
                 idx, vals = _pad_scatter_pow2(
                     np.asarray(cids, dtype=np.int32), tiles
                 )
                 self._dev_arrays = self._dev_arrays.at[idx].set(vals)
+                if ftiles is not None:
+                    fidx, fvals = _pad_scatter_pow2(
+                        np.asarray(cids, dtype=np.int32), ftiles
+                    )
+                    self._dev_fids = self._dev_fids.at[fidx].set(fvals)
             else:
-                self._apply_segment_delta(t, cids, tiles)
+                self._apply_segment_delta(t, cids, tiles, ftiles)
             self.uploads += 1
             self.delta_uploads += 1
-            self.upload_bytes += tiles.nbytes
+            self.upload_bytes += tiles.nbytes + (
+                ftiles.nbytes if ftiles is not None else 0)
         self._dev_version = t.version
         self._dev_fid_map = t._fid_of_row
         return True
 
-    def _apply_segment_delta(self, t: PartitionedTable, cids, tiles) -> None:
+    def _apply_segment_delta(self, t: PartitionedTable, cids, tiles,
+                             ftiles=None) -> None:
         """Scatter dirty chunks into their segment arrays (global chunk
         ``cid`` lives at local index ``cid - base + 1`` for segments > 0;
         see ``_build_segments``) and advance each segment's live end as the
-        table grows into the built-in padding."""
+        table grows into the built-in padding. ``ftiles`` carries the
+        matching fid-row chunks when the fused pipeline keeps the row→fid
+        map device-resident."""
         cid_arr = np.asarray(cids, dtype=np.int64)
         segs = []
-        for si, (base, _end, dev) in enumerate(self._segments):
+        for si, (base, _end, dev, fdev) in enumerate(self._segments):
             sel = (cid_arr >= base) & (cid_arr < base + self._seg_cap)
             loc = cid_arr[sel] if si == 0 else cid_arr[sel] - (base - 1)
             if len(loc):
@@ -1565,10 +2079,15 @@ class PartitionedMatcher:
                     loc.astype(np.int32), tiles[np.nonzero(sel)[0]]
                 )
                 dev = dev.at[idx].set(vals)
-            segs.append((base, min(base + self._seg_cap, t.nchunks), dev))
+                if ftiles is not None and fdev is not None:
+                    fidx, fvals = _pad_scatter_pow2(
+                        loc.astype(np.int32), ftiles[np.nonzero(sel)[0]]
+                    )
+                    fdev = fdev.at[fidx].set(fvals)
+            segs.append((base, min(base + self._seg_cap, t.nchunks), dev, fdev))
         self._segments = segs
 
-    def _build_segments(self, packed: np.ndarray, put):
+    def _build_segments(self, packed: np.ndarray, fids2d, put):
         """Split the packed table into ≤``_seg_bytes`` device arrays.
 
         Segment 0 keeps the global chunk numbering (it contains the
@@ -1576,7 +2095,10 @@ class PartitionedMatcher:
         as its local padding target, so global chunk ``cid`` lives at local
         ``cid - base + 1`` and a local match row maps back to the global
         row space by the affine offset ``(base-1)*CHUNK`` (chunk 0 never
-        matches, so every real match has local chunk ≥ 1)."""
+        matches, so every real match has local chunk ≥ 1). ``fids2d``
+        (row→fid chunks, may be None) splits identically so the fused
+        pipeline's device decode works per segment — its fids are GLOBAL,
+        so segment results merge by plain concatenation."""
         total = packed.shape[0]
         nseg = -(-packed.nbytes // self._seg_bytes)
         seg_chunks = -(-total // nseg)
@@ -1585,17 +2107,20 @@ class PartitionedMatcher:
         align = 4096 if seg_chunks >= 4096 else (64 if seg_chunks >= 64 else 8)
         seg_chunks = (seg_chunks + align - 1) // align * align
         self._seg_cap = seg_chunks
-        segs: List[Tuple[int, int, object]] = []
+        segs: List[Tuple] = []
         for base in range(0, total, seg_chunks):
-            part = packed[base : base + seg_chunks]
-            pads = [(0, 0)] * part.ndim
-            if base > 0:
-                pads[0] = (1, seg_chunks - part.shape[0])
-            else:
-                pads[0] = (0, seg_chunks - part.shape[0])
-            if any(p != (0, 0) for p in pads):
-                part = np.pad(part, pads)
-            segs.append((base, min(base + seg_chunks, total), put(part)))
+            lead = 1 if base > 0 else 0
+
+            def cut(arr, fill=0):
+                part = arr[base : base + seg_chunks]
+                pads = [(0, 0)] * part.ndim
+                pads[0] = (lead, seg_chunks - part.shape[0])
+                if any(p != (0, 0) for p in pads):
+                    part = np.pad(part, pads, constant_values=fill)
+                return put(part)
+
+            fdev = cut(fids2d, fill=-1) if fids2d is not None else None
+            segs.append((base, min(base + seg_chunks, total), cut(packed), fdev))
         return segs
 
     def match_submit(self, topics: Sequence[str], pad_to_pow2: bool = True):
@@ -1627,60 +2152,96 @@ class PartitionedMatcher:
                     padded = max(BT, padded)
                 except ImportError:
                     self._pallas = False
+            if padded < self._pad_floor:
+                # sticky small-batch shape floor (prewarm()): a 1-topic
+                # publish reuses the already-compiled floor-shape
+                # executable instead of compiling its own 1/2/4-shapes
+                padded = self._pad_floor
         else:
             padded = b
+        t_enc = time.perf_counter_ns() if self.stage_timing else 0
         want_groups = self.compact_mode == "global"
         while True:
             enc, enc_epoch = t.encode_topics_versioned(
                 topics, pad_batch_to=padded, with_groups=want_groups
             )
             dev = self._refresh()
-            if self._dev_epoch == enc_epoch:
-                break
-            # a compaction installed between the encode and the device
-            # refresh: the chunk ids reference the OLD layout while the
-            # device now holds the new one — re-encode (rare, bounded by
-            # compaction frequency)
-        snap = _Snap(self._dev_version, self._dev_epoch, self._dev_fid_map)
-        ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
-        if self._segments is not None:
-            return self._submit_segmented(ttok, tlen, tdollar, chunk_ids, b, snap)
-        words = self._words(dev, ttok, tlen, tdollar, chunk_ids)
-        if self.compact_mode == "global":
-            if words is not None:
-                g = self._budget_for(padded, _nc)
-                packed = _compact_global(words, budget=g)
-                return ("g", b, chunk_ids, words,
-                        (dev, ttok, tlen, tdollar, None), packed, g, 0, snap)
-            split = self._split_plan(chunk_ids, b)
-            if split is not None:
-                return self._submit_split(
-                    dev, ttok, tlen, tdollar, chunk_ids, split, 0, snap
-                )
-            grouped = self._group_inputs(enc[5], chunk_ids)
-            g = self._budget_for(padded, _nc)
-            if grouped is None:  # batch doesn't dedup; plain upload
-                packed = _match_global(
-                    dev, ttok, tlen, tdollar, chunk_ids, budget=g
-                )
+            if self._dev_epoch != enc_epoch:
+                # a compaction installed between the encode and the device
+                # refresh: the chunk ids reference the OLD layout while the
+                # device now holds the new one — re-encode (rare, bounded
+                # by compaction frequency)
+                continue
+            if self._dev_playout is not None:
+                # bit-packed tiles: topic tokens re-key into the per-level
+                # local id spaces. A layout change racing the refresh
+                # (width widening / deeper prefix) re-encodes, same as the
+                # compaction race above.
+                lay, tt = t.translate_packed(enc[0])
+                if lay != self._dev_playout:
+                    continue
             else:
-                packed = _match_global_grouped(
-                    dev, ttok, tlen, tdollar, *grouped, budget=g
+                tt = enc[0]
+            break
+        snap = _Snap(self._dev_version, self._dev_epoch, self._dev_fid_map)
+        _ttok, tlen, tdollar, chunk_ids, _nc = enc[:5]
+        if t_enc:
+            now = time.perf_counter_ns()
+            self.stage_ns["encode"] += now - t_enc
+            t_enc = now
+        try:
+            if self._segments is not None:
+                return self._submit_segmented(tt, tlen, tdollar, chunk_ids, b,
+                                              snap)
+            if self._fused is not False and self.compact_mode == "global":
+                handle = self._submit_fused(
+                    dev, tt, tlen, tdollar, chunk_ids,
+                    enc[5] if want_groups else None, padded, b, snap)
+                if handle is not None:
+                    return handle
+            words = self._words(dev, tt, tlen, tdollar, chunk_ids)
+            lay = self._dev_playout
+            if self.compact_mode == "global":
+                if words is not None:
+                    g = self._budget_for(padded, _nc)
+                    packed = _compact_global(words, budget=g)
+                    return ("g", b, chunk_ids, words,
+                            (dev, tt, tlen, tdollar, None, lay), packed, g, 0,
+                            snap)
+                split = self._split_plan(chunk_ids, b)
+                if split is not None:
+                    return self._submit_split(
+                        dev, tt, tlen, tdollar, chunk_ids, split, 0, snap
+                    )
+                grouped = self._group_inputs(enc[5], chunk_ids)
+                g = self._budget_for(padded, _nc)
+                if grouped is None:  # batch doesn't dedup; plain upload
+                    packed = _match_global(
+                        dev, tt, tlen, tdollar, chunk_ids, budget=g, layout=lay
+                    )
+                else:
+                    packed = _match_global_grouped(
+                        dev, tt, tlen, tdollar, *grouped, budget=g, layout=lay
+                    )
+                # the handle carries ITS OWN budget: a sticky widening by a
+                # later handle must not mask this one's truncation
+                return ("g", b, chunk_ids, words,
+                        (dev, tt, tlen, tdollar, grouped, lay), packed, g, 0,
+                        snap)
+            wi, wb, cn = (
+                _compact_words(words, max_words=self.max_words)
+                if words is not None
+                else _match_partitioned(
+                    dev, tt, tlen, tdollar, chunk_ids,
+                    max_words=self.max_words, layout=lay
                 )
-            # the handle carries ITS OWN budget: a sticky widening by a later
-            # handle must not mask this one's truncation
-            return ("g", b, chunk_ids, words, (dev, ttok, tlen, tdollar, grouped),
-                    packed, g, 0, snap)
-        wi, wb, cn = (
-            _compact_words(words, max_words=self.max_words)
-            if words is not None
-            else _match_partitioned(
-                dev, ttok, tlen, tdollar, chunk_ids, max_words=self.max_words
             )
-        )
-        # same contract: the handle carries ITS OWN max_words
-        return ("k", b, chunk_ids, words, (dev, ttok, tlen, tdollar), wi, wb, cn,
-                self.max_words, snap)
+            # same contract: the handle carries ITS OWN max_words
+            return ("k", b, chunk_ids, words, (dev, tt, tlen, tdollar, lay),
+                    wi, wb, cn, self.max_words, snap)
+        finally:
+            if t_enc:
+                self.stage_ns["dispatch"] += time.perf_counter_ns() - t_enc
 
     # ------------------------------------------------- NC split-dispatch
     SPLIT_MIN_BATCH = 1024  # small batches are dispatch-bound, not compute
@@ -1741,14 +2302,250 @@ class PartitionedMatcher:
             self._budgets[(padded, nc)] = g
         return g
 
+    # ------------------------------------------------- fused pipeline
+    def _submit_fused(self, dev, tt, tlen, tdollar, chunk_ids, groups,
+                      padded: int, b: int, snap, fdev=None):
+        """Dispatch one batch through the fused match→compact→decode
+        pipeline (single-array tables). Returns a handle, a pre-resolved
+        ``("r", results)`` handle (first-use verify consumed the batch), or
+        None when fused is ruled out and the caller should fall back."""
+        fdev = fdev if fdev is not None else self._dev_fids
+        if fdev is None:
+            return None
+        self._maybe_decide_pallas(dev, tt, tlen, tdollar, chunk_ids)
+        g = self._budget_for(padded, chunk_ids.shape[1])
+        if self._fused is None:
+            ok, results = self._decide_fused(
+                dev, fdev, tt, tlen, tdollar, chunk_ids, b, g, snap)
+            if ok is not None:  # None = vacuous batch, stay undecided
+                self._fused = ok
+            if results is not None:
+                return ("r", results)
+            return None
+        lay = self._dev_playout
+        split = self._split_plan(chunk_ids, b)
+        if split is not None:
+            return self._submit_fused_split(
+                dev, fdev, tt, tlen, tdollar, chunk_ids, split, lay)
+        use_pallas = (bool(self._pallas)
+                      and chunk_ids.shape[0] % _pallas_bt() == 0)
+        grouped = self._group_inputs(groups, chunk_ids) if groups is not None else None
+        if grouped is None:
+            packed = _match_fused(
+                dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g, layout=lay,
+                use_pallas=use_pallas, interpret=self._pallas_interpret)
+        else:
+            packed = _match_fused_grouped(
+                dev, fdev, tt, tlen, tdollar, *grouped, budget=g, layout=lay,
+                use_pallas=use_pallas, interpret=self._pallas_interpret)
+        return ("f", b, padded,
+                (dev, fdev, tt, tlen, tdollar, chunk_ids, grouped, lay,
+                 use_pallas), packed, g)
+
+    def _decide_fused(self, dev, fdev, tt, tlen, tdollar, chunk_ids, b: int,
+                      g: int, snap, fid_base: int = 0):
+        """First-use self-check of the fused pipeline against the lax
+        reference (words → global compact → HOST decode through the
+        snapshot machinery) on the live batch — the same contract as the
+        Pallas kernel's verify: routing results must never depend on an
+        unverified device path. → ``(ok, results)``; results (from the
+        reference, which is correct either way) may be served directly."""
+        lay = self._dev_playout
+        log = _LOG
+        try:
+            packed = _match_fused(dev, fdev, tt, tlen, tdollar, chunk_ids,
+                                  budget=g, layout=lay)
+            got = self._complete_fused(
+                ("f", b, chunk_ids.shape[0],
+                 (dev, fdev, tt, tlen, tdollar, chunk_ids, None, lay, False),
+                 packed, g))
+        except Exception as e:
+            log.warning("fused pipeline unavailable (%s); using the "
+                        "words+host-decode path", e)
+            return False, None
+        ref_packed = _match_global(dev, tt, tlen, tdollar, chunk_ids,
+                                   budget=g, layout=lay)
+        want = self._complete_global(
+            ("g", b, chunk_ids, None, (dev, tt, tlen, tdollar, None, lay),
+             ref_packed, g, fid_base, snap))
+        if not any(len(w) for w in want):
+            # a zero-match batch (empty table, the broker's prewarm probe)
+            # would latch the verify on an empty-vs-empty comparison — the
+            # vacuous-oracle trap the PR6 canary fell into. Serve the
+            # (correct) reference and stay undecided until a batch with
+            # real matches exercises the fid-resolve/sort path for real.
+            self.fused_batches -= 1
+            return None, want
+        agree = len(got) == len(want) and all(
+            np.array_equal(a, w) for a, w in zip(got, want))
+        if not agree:
+            log.warning("fused pipeline disagrees with the lax+host-decode "
+                        "reference; disabled")
+            self.fused_batches -= 1  # the verify run doesn't count as served
+            return False, want
+        log.info("fused match→compact→decode pipeline verified; enabled")
+        return True, want
+
+    def _submit_fused_split(self, dev, fdev, tt, tlen, tdollar, chunk_ids,
+                            split, lay):
+        """Fused NC split-dispatch: same host-side bucketing as
+        ``_submit_split``, fused epilogue per bucket, one dispatch."""
+        order, sizes, tiers = split
+        b = len(order)
+        parts: List[Tuple] = []
+        meta: List[Tuple[int, int, int]] = []
+        budgets: List[int] = []
+        pos = 0
+        for tier, s in zip(tiers, sizes):
+            s = int(s)
+            if not s:
+                continue
+            idx = order[pos : pos + s]
+            pos += s
+            pb = 1 << (s - 1).bit_length() if s > 1 else 1
+            pt = np.zeros((pb, tt.shape[1]), dtype=tt.dtype)
+            pt[:s] = tt[idx]
+            pl = np.full((pb,), -2, dtype=tlen.dtype)
+            pl[:s] = tlen[idx]
+            pd = np.zeros((pb,), dtype=bool)
+            pd[:s] = tdollar[idx]
+            pc = np.zeros((pb, tier), dtype=chunk_ids.dtype)
+            pc[:s] = chunk_ids[idx, :tier]
+            gb = self._budget_for(pb, tier)
+            parts.append((pt, pl, pd, pc))
+            meta.append((s, pb, tier))
+            budgets.append(gb)
+        packed = _match_fused_split(dev, fdev, tuple(parts), tuple(budgets),
+                                    layout=lay)
+        return ("fs", b, order, meta, parts, (dev, fdev, lay), packed,
+                tuple(budgets))
+
+    def _complete_fused(self, handle) -> List[np.ndarray]:
+        """Block on a fused handle: ONE fetch of ``[fids..., cnts...]``;
+        the host's whole decode is an ``np.split`` by counts (the device
+        already resolved rows→fids and sorted per topic)."""
+        _tag, b, padded, rerun, packed, g = handle
+        (dev, fdev, tt, tlen, tdollar, chunk_ids, grouped, lay,
+         use_pallas) = rerun
+        t0 = time.perf_counter_ns() if self.stage_timing else 0
+        while True:
+            arr = fetch(packed, "fused match fetch")
+            cn = arr[g:].astype(np.int64)
+            n = int(cn.sum())
+            if n <= g:
+                break
+            g = 1 << max(8, (n - 1).bit_length())
+            key = (chunk_ids.shape[0], chunk_ids.shape[1])
+            self._budgets[key] = max(self._budgets.get(key, 0), g)
+            if grouped is None:
+                packed = _match_fused(
+                    dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g,
+                    layout=lay, use_pallas=use_pallas,
+                    interpret=self._pallas_interpret)
+            else:
+                packed = _match_fused_grouped(
+                    dev, fdev, tt, tlen, tdollar, *grouped, budget=g,
+                    layout=lay, use_pallas=use_pallas,
+                    interpret=self._pallas_interpret)
+        if t0:
+            now = time.perf_counter_ns()
+            self.stage_ns["fetch"] += now - t0
+            t0 = now
+        if cn[b:].any():
+            # same fail-loudly contract as the host decoders: a padded topic
+            # (tlen=-2, can match nothing) with routes is a device bug
+            raise AssertionError("padded topic produced routes — device bug")
+        out = self._split_fused_wire(arr, cn, n, b)
+        self.fused_batches += 1
+        if t0:
+            self.stage_ns["decode"] += time.perf_counter_ns() - t0
+        return out
+
+    @staticmethod
+    def _split_fused_wire(arr, cn, n: int, b: int) -> List[np.ndarray]:
+        flat = arr[:n].astype(np.int64)
+        if n and int(flat.min()) < 0:
+            # a -1 here means a cleared row's bit survived to the final
+            # output — device or compaction bug, never valid concurrency
+            raise AssertionError(
+                "cleared-row fid escaped the fused device decode")
+        bounds = np.cumsum(cn[: b - 1])
+        return np.split(flat, bounds)
+
+    def _complete_fused_split(self, handle) -> List[np.ndarray]:
+        _tag, b, order, meta, parts, ctx, packed, budgets = handle
+        dev, fdev, lay = ctx
+        t0 = time.perf_counter_ns() if self.stage_timing else 0
+        while True:
+            arr = fetch(packed, "fused match fetch")
+            segs = []
+            regrow = list(budgets)
+            ok = True
+            o = 0
+            for bi, ((s, pb, tier), g) in enumerate(zip(meta, budgets)):
+                fid_seg = arr[o : o + g]
+                cn = arr[o + g : o + g + pb].astype(np.int64)
+                o += g + pb
+                segs.append((fid_seg, cn))
+                n = int(cn.sum())
+                if n > g:
+                    ok = False
+                    g2 = 1 << max(8, (n - 1).bit_length())
+                    regrow[bi] = g2
+                    self._budgets[(pb, tier)] = max(
+                        self._budgets.get((pb, tier), 0), g2)
+            if ok:
+                break
+            budgets = tuple(regrow)
+            packed = _match_fused_split(dev, fdev, tuple(parts), budgets,
+                                        layout=lay)
+        if t0:
+            now = time.perf_counter_ns()
+            self.stage_ns["fetch"] += now - t0
+            t0 = now
+        out: List[Optional[np.ndarray]] = [None] * b
+        pos = 0
+        for (s, pb, tier), (fid_seg, cn) in zip(meta, segs):
+            if cn[s:].any():
+                raise AssertionError("padded topic produced routes — device bug")
+            rows = self._split_fused_wire(fid_seg, cn, int(cn.sum()), s)
+            for orig, r in zip(order[pos : pos + s], rows):
+                out[orig] = r
+            pos += s
+        self.fused_batches += 1
+        if t0:
+            self.stage_ns["decode"] += time.perf_counter_ns() - t0
+        return out
+
+    def prewarm(self, batch_sizes: Sequence[int] = (1, 8)) -> None:
+        """Pre-compile the small-batch dispatch shapes and latch the
+        LARGEST as the sticky pad floor, so cfg1-style traffic (a lone
+        publish per dispatch) reuses one already-compiled executable
+        instead of paying a fresh XLA compile per distinct tiny shape.
+        Safe to call from a background thread at broker start; matches
+        run against the live table and results are discarded."""
+        sizes = sorted(set(int(s) for s in batch_sizes if s > 0))
+        if not sizes:
+            return
+        try:
+            for s in sizes:
+                self.match(["\x00prewarm/nomatch"] * s)
+            self._pad_floor = max(self._pad_floor, sizes[-1])
+        except Exception as e:  # pragma: no cover - defensive
+            _LOG.warning("matcher prewarm failed (%s); first small "
+                         "publishes will pay the compile", e)
+
     def _submit_segmented(self, ttok, tlen, tdollar, chunk_ids, b: int, snap):
         """One sub-handle per table segment: global candidate chunk ids are
         remapped to segment-local ids (front-packed, trimmed to a sticky
         per-segment NC), matched against the segment's device array, and
-        decoded through the segment's affine slice of the fid map."""
+        decoded through the segment's affine slice of the fid map — or, on
+        the fused pipeline, through the segment's device fid rows (which
+        carry GLOBAL fids, so segment results merge by concatenation)."""
         cid = chunk_ids.astype(np.int32, copy=False)
+        lay = self._dev_playout
         handles = []
-        for si, (base, end, dev) in enumerate(self._segments):
+        for si, (base, end, dev, fdev) in enumerate(self._segments):
             if base == 0:
                 loc = np.where(cid < end, cid, 0)
                 fid_base = 0
@@ -1770,16 +2567,35 @@ class PartitionedMatcher:
                 loc = np.pad(loc, ((0, 0), (0, ncs - loc.shape[1])))
             if loc.max(initial=0) < 0x10000:
                 loc = loc.astype(np.uint16)
+            padded = loc.shape[0]
+            if self._fused is not False and fdev is not None:
+                if self._fused is None:
+                    g = self._budget_for(padded, ncs)
+                    ok, results = self._decide_fused(
+                        dev, fdev, ttok, tlen, tdollar, loc, b, g, snap,
+                        fid_base)
+                    if ok is not None:  # None = vacuous, stay undecided
+                        self._fused = ok
+                    if results is not None:
+                        handles.append(("r", results))
+                        continue
+                if self._fused:
+                    h = self._submit_fused(dev, ttok, tlen, tdollar, loc,
+                                           None, padded, b, snap, fdev=fdev)
+                    if h is not None:
+                        handles.append(h)
+                        continue
             split = self._split_plan(loc, b)
             if split is not None:
                 handles.append(self._submit_split(
                     dev, ttok, tlen, tdollar, loc, split, fid_base, snap
                 ))
                 continue
-            padded = loc.shape[0]
             g = self._budget_for(padded, ncs)
-            packed = _match_global(dev, ttok, tlen, tdollar, loc, budget=g)
-            handles.append(("g", b, loc, None, (dev, ttok, tlen, tdollar, None),
+            packed = _match_global(dev, ttok, tlen, tdollar, loc, budget=g,
+                                   layout=lay)
+            handles.append(("g", b, loc, None,
+                            (dev, ttok, tlen, tdollar, None, lay),
                             packed, g, fid_base, snap))
         return ("M", b, handles)
 
@@ -1787,10 +2603,15 @@ class PartitionedMatcher:
 
     def _complete_segmented(self, handle) -> List[np.ndarray]:
         _tag, b, handles = handle
+        fused_before = self.fused_batches
         per_seg = [
             [self._EMPTY_FIDS] * b if h[0] == "E" else self.match_complete(h)
             for h in handles
         ]
+        if self.fused_batches > fused_before:
+            # per-segment completes each bump the counter, but they are ONE
+            # logical batch — the stat must stay comparable with dispatches
+            self.fused_batches = fused_before + 1
         out: List[np.ndarray] = []
         for i in range(b):
             arrs = [s[i] for s in per_seg if len(s[i])]
@@ -1831,12 +2652,15 @@ class PartitionedMatcher:
             parts.append((pt, pl, pd, pc))
             meta.append((s, pb, tier))
             budgets.append(g)
-        packed = _match_global_split(dev, tuple(parts), tuple(budgets))
-        return ("s", b, order, meta, parts, dev, packed, tuple(budgets), fid_base,
-                snap)
+        lay = self._dev_playout
+        packed = _match_global_split(dev, tuple(parts), tuple(budgets),
+                                     layout=lay)
+        return ("s", b, order, meta, parts, (dev, lay), packed, tuple(budgets),
+                fid_base, snap)
 
     def _complete_split(self, handle) -> List[np.ndarray]:
-        _tag, b, order, meta, parts, dev, packed, budgets, fid_base, snap = handle
+        _tag, b, order, meta, parts, ctx, packed, budgets, fid_base, snap = handle
+        dev, lay = ctx
         while True:
             arr = fetch(packed, "match result fetch")
             segs: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -1859,7 +2683,8 @@ class PartitionedMatcher:
             if ok:
                 break
             budgets = tuple(regrow)
-            packed = _match_global_split(dev, tuple(parts), budgets)
+            packed = _match_global_split(dev, tuple(parts), budgets,
+                                         layout=lay)
         # the decode snapshot is taken AFTER the blocking fetch (like every
         # other complete path); _decode_revalidated closes the
         # overlay→gather write window without stalling mutations
@@ -1928,6 +2753,12 @@ class PartitionedMatcher:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
         if handle[0] == "M":
             return self._complete_segmented(handle)
+        if handle[0] == "r":
+            return handle[1]  # pre-resolved (first-use fused verify)
+        if handle[0] == "f":
+            return self._complete_fused(handle)
+        if handle[0] == "fs":
+            return self._complete_fused_split(handle)
         if handle[0] == "s":
             return self._complete_split(handle)
         if handle[0] == "g":
@@ -1943,9 +2774,10 @@ class PartitionedMatcher:
             if words is not None:
                 wi, wb, cn = _compact_words(words, max_words=kw)
             else:
-                dev, ttok, tlen, tdollar = dev_inputs
+                dev, ttok, tlen, tdollar, lay = dev_inputs
                 wi, wb, cn = _match_partitioned(
-                    dev, ttok, tlen, tdollar, chunk_ids, max_words=kw
+                    dev, ttok, tlen, tdollar, chunk_ids, max_words=kw,
+                    layout=lay
                 )
         return self._decode_revalidated(
             snap, 0,
@@ -1977,6 +2809,7 @@ class PartitionedMatcher:
     def _complete_global(self, handle) -> List[np.ndarray]:
         _tag, b, chunk_ids, words, dev_inputs, packed, g, fid_base, snap = handle
         padded, nc = chunk_ids.shape
+        t0 = time.perf_counter_ns() if self.stage_timing else 0
         while True:
             # ONE fetch per match: [routes..., cnts...] (counts are
             # truncation-exact, so overflow is detectable from the same
@@ -1992,20 +2825,29 @@ class PartitionedMatcher:
             if words is not None:
                 packed = _compact_global(words, budget=g)
             else:
-                dev, ttok, tlen, tdollar, grouped = dev_inputs
+                dev, ttok, tlen, tdollar, grouped, lay = dev_inputs
                 if grouped is None:
                     packed = _match_global(
-                        dev, ttok, tlen, tdollar, chunk_ids, budget=g
+                        dev, ttok, tlen, tdollar, chunk_ids, budget=g,
+                        layout=lay
                     )
                 else:
                     packed = _match_global_grouped(
-                        dev, ttok, tlen, tdollar, *grouped, budget=g
+                        dev, ttok, tlen, tdollar, *grouped, budget=g,
+                        layout=lay
                     )
-        return self._decode_revalidated(
+        if t0:
+            now = time.perf_counter_ns()
+            self.stage_ns["fetch"] += now - t0
+            t0 = now
+        out = self._decode_revalidated(
             snap, fid_base,
             lambda fid_map, overlay, strict: _decode_routes(
                 arr[:n], cn, chunk_ids, b, fid_map,
                 overlay=overlay, strict=strict))
+        if t0:
+            self.stage_ns["decode"] += time.perf_counter_ns() - t0
+        return out
 
     def match(self, topics: Sequence[str], pad_to_pow2: bool = True) -> List[np.ndarray]:
         return self.match_complete(self.match_submit(topics, pad_to_pow2))

@@ -149,8 +149,21 @@ class ShardedPartitionedMatcher:
         self.compact_mode = compact or os.environ.get("RMQTT_COMPACT", "global")
         self._budgets = {}  # padded batch size -> sticky pow2 PER-DEVICE slots
         self._gsteps = {}  # per-device budget -> jitted shard_map step
+        self._fsteps = {}  # per-device budget -> jitted FUSED shard_map step
+        # fused match→compact→decode mirror (ops/partitioned.py): each shard
+        # resolves its routes to GLOBAL fids through a replicated device
+        # row→fid map and sorts per topic, so the host decode drops to one
+        # np.split per shard. Verified against the legacy path on first use
+        # (RMQTT_FUSED=0/1 forces off/on), exactly like the local matcher.
+        env_fused = os.environ.get("RMQTT_FUSED", "")
+        self._fused = (
+            False if env_fused == "0" or self.compact_mode != "global"
+            else (True if env_fused == "1" else None)
+        )
+        self.fused_batches = 0
         self._dev_version = -1
         self._dev_rows = None
+        self._dev_fids = None
         # replicated delta puts: mutations scatter only their dirty chunks
         # into the replicated table (mirrors PartitionedMatcher._refresh);
         # the scatter runs as one jnp op so the update replicates over ICI
@@ -192,12 +205,45 @@ class ShardedPartitionedMatcher:
         self._gsteps[budget_per_dev] = step
         return step
 
+    def _fused_step(self, budget_per_dev: int):
+        step = self._fsteps.get(budget_per_dev)
+        if step is not None:
+            return step
+        from rmqtt_tpu.ops.partitioned import (
+            fused_compact_decode_impl,
+            scan_words_impl,
+        )
+
+        axes = ("dp", "fp")
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(axes, None), P(axes), P(axes), P(axes, None)),
+            out_specs=P(axes),
+        )
+        def fstep(rows, fid_rows, ttok, tlen, td, cids):
+            words = scan_words_impl(rows, ttok, tlen, td, cids)
+            # per-device [fids(budget)... | cnts(bl)...] int32: each shard
+            # resolves its topic slice's routes to GLOBAL fids through the
+            # replicated row→fid map and sorts (topic, fid) on device —
+            # shard-major == topic-major, so the host reattributes from the
+            # concatenated counts exactly like the unfused wire
+            return fused_compact_decode_impl(words, fid_rows, cids,
+                                             budget_per_dev)
+
+        step = jax.jit(fstep)
+        self._fsteps[budget_per_dev] = step
+        return step
+
     def _refresh(self):
         from rmqtt_tpu.ops.partitioned import (
             _pad_scatter_pow2,
             delta_chunk_plan,
             pack_chunk_tiles,
             pack_device_rows,
+            pack_fid_chunk_tiles,
+            pack_fid_rows,
         )
 
         t = self.table
@@ -205,6 +251,7 @@ class ShardedPartitionedMatcher:
             return self._dev_rows
         if _FP_UPLOAD.action is not None:  # chaos seam (utils/failpoints.py)
             _FP_UPLOAD.fire_sync()
+        want_fids = self._fused is not False and self.compact_mode == "global"
         with t._mu:
             if self._dev_version == t.version and self._dev_rows is not None:
                 return self._dev_rows
@@ -216,7 +263,12 @@ class ShardedPartitionedMatcher:
                 dev_dtype=self._dev_dtype, dt=dt,
                 dev_up_chunks=self._dev_up_chunks,
             )
-            if cids is not None:
+            if cids is not None and not (want_fids and self._dev_fids is None):
+                if not want_fids and self._dev_fids is not None:
+                    # fused ruled out after the fid map went resident: drop
+                    # it so delta refreshes stop shipping tiles nothing
+                    # reads (mirrors PartitionedMatcher._try_delta_refresh)
+                    self._dev_fids = None
                 if cids:
                     tiles = pack_chunk_tiles(t, cids, dt)
                     idx, vals = _pad_scatter_pow2(
@@ -226,6 +278,13 @@ class ShardedPartitionedMatcher:
                     self.uploads += 1
                     self.delta_uploads += 1
                     self.upload_bytes += tiles.nbytes
+                    if want_fids and self._dev_fids is not None:
+                        ftiles = pack_fid_chunk_tiles(t, cids)
+                        fidx, fvals = _pad_scatter_pow2(
+                            np.asarray(cids, dtype=np.int32), ftiles
+                        )
+                        self._dev_fids = self._dev_fids.at[fidx].set(fvals)
+                        self.upload_bytes += ftiles.nbytes
                 self._dev_version = t.version
                 self._dev_fid_map = t._fid_of_row
                 return self._dev_rows
@@ -234,10 +293,15 @@ class ShardedPartitionedMatcher:
             # put must not stall subscribes); mutations landing during the
             # transfer stay pending via the captured version
             packed = pack_device_rows(t)
+            fids2d = pack_fid_rows(t) if want_fids else None
             version, epoch, lvl = t.version, t.layout_epoch, t.max_levels
             fid_map = t._fid_of_row
         self._dev_rows = jax.device_put(
             packed, NamedSharding(self.mesh, P())  # replicated
+        )
+        self._dev_fids = (
+            jax.device_put(fids2d, NamedSharding(self.mesh, P()))
+            if fids2d is not None else None
         )
         self._dev_version = version
         self._dev_epoch = epoch
@@ -247,7 +311,8 @@ class ShardedPartitionedMatcher:
         self._dev_fid_map = fid_map
         self.uploads += 1
         self.full_uploads += 1
-        self.upload_bytes += packed.nbytes
+        self.upload_bytes += packed.nbytes + (
+            fids2d.nbytes if fids2d is not None else 0)
         return self._dev_rows
 
     def match(self, topics) -> list:
@@ -320,6 +385,83 @@ class ShardedPartitionedMatcher:
             return decode(*self._decode_state())
 
     def _match_global(self, dev, inputs, chunk_ids, b: int, padded: int) -> list:
+        if self._fused is not False and self._dev_fids is not None:
+            import logging
+
+            log = logging.getLogger("rmqtt_tpu.ops")
+            if self._fused is True:
+                # verified: run it straight — the fail-loud AssertionErrors
+                # (cleared-row fid, padded-topic routes) are device-bug
+                # signals that must PROPAGATE, exactly like the local
+                # matcher's, not be demoted to a silent fallback
+                out = self._match_fused(dev, inputs, chunk_ids, b, padded)
+                self.fused_batches += 1
+                return out
+            try:
+                # still deciding: a compile/availability failure here is a
+                # legitimate reason to fall back, not a corruption signal
+                got = self._match_fused(dev, inputs, chunk_ids, b, padded)
+            except Exception as e:
+                log.warning("sharded fused pipeline unavailable (%s); using "
+                            "the words+host-decode path", e)
+                self._fused = False
+                got = None
+            if got is not None:
+                if self._fused is None:
+                    # first-use self-check against the legacy wire + host
+                    # decode (same contract as the local matcher). A
+                    # zero-match batch must not latch the verify on an
+                    # empty-vs-empty comparison — serve the reference and
+                    # stay undecided until real matches flow.
+                    want = self._match_global_unfused(
+                        dev, inputs, chunk_ids, b, padded)
+                    if not any(len(np.asarray(w)) for w in want):
+                        return want
+                    agree = len(got) == len(want) and all(
+                        np.array_equal(a, w) for a, w in zip(got, want))
+                    self._fused = agree
+                    if not agree:
+                        log.warning("sharded fused pipeline disagrees with "
+                                    "the host-decode reference; disabled")
+                        return want
+                    log.info("sharded fused pipeline verified; enabled")
+                self.fused_batches += 1
+                return got
+        return self._match_global_unfused(dev, inputs, chunk_ids, b, padded)
+
+    def _match_fused(self, dev, inputs, chunk_ids, b: int, padded: int) -> list:
+        """Fused wire: per-device ``[fids(gd)... | cnts(bl)...]`` int32 —
+        final GLOBAL fids, device-sorted per topic; host work is np.split."""
+        gd = self._budgets.get(padded)
+        if gd is None:
+            gd = max(256, 1 << (4 * (padded // self.ndev) - 1).bit_length())
+            self._budgets[padded] = gd
+        bl = padded // self.ndev
+        while True:
+            arr = fetch(self._fused_step(gd)(dev, self._dev_fids, *inputs),
+                        "sharded fused fetch")
+            per_dev = arr.reshape(self.ndev, gd + bl)
+            cn = per_dev[:, gd:].astype(np.int64)
+            totals = cn.sum(axis=1)
+            mx = int(totals.max(initial=0))
+            if mx <= gd:
+                break
+            gd = 1 << max(8, (mx - 1).bit_length())
+            self._budgets[padded] = max(self._budgets[padded], gd)
+        flat_cn = cn.ravel()
+        if flat_cn[b:].any():
+            raise AssertionError("padded topic produced routes — device bug")
+        parts = [per_dev[i, : int(totals[i])].astype(np.int64)
+                 for i in range(self.ndev)]
+        flat = np.concatenate(parts) if parts else np.empty(0, np.int64)
+        if flat.size and int(flat.min()) < 0:
+            raise AssertionError(
+                "cleared-row fid escaped the fused device decode")
+        bounds = np.cumsum(flat_cn[: b - 1])
+        return np.split(flat, bounds)
+
+    def _match_global_unfused(self, dev, inputs, chunk_ids, b: int,
+                              padded: int) -> list:
         from rmqtt_tpu.ops.partitioned import _decode_routes
 
         gd = self._budgets.get(padded)

@@ -264,6 +264,17 @@ class XlaRouter(Router):
                     {"backend": "xla", "batch": len(items)})
         return self._expand(items, rows)
 
+    def prewarm(self, batch_sizes=(1, 8)) -> None:
+        """Pre-compile the device matcher's small dispatch shapes (and
+        latch its sticky pad floor) so the first lone publishes after
+        start don't pay an XLA compile. Called by RoutingService.start()
+        on a background thread; safe no-op for matchers without the hook
+        or before any subscription exists (compiles are shape-keyed, so
+        warming an empty table still covers the live shapes)."""
+        m = getattr(self, "matcher", None)
+        if m is not None and hasattr(m, "prewarm"):
+            m.prewarm(batch_sizes)
+
     def last_match_was_device(self) -> bool:
         """Did the most recent (synchronously resolved) match run on the
         DEVICE matcher? The routing service consults this before crediting
@@ -360,6 +371,10 @@ class XlaRouter(Router):
             "compactions": getattr(t, "compactions", 0),
             "compact_ms": round(getattr(t, "compact_ms", 0.0), 3),
             "cand_cache_invalidations": getattr(t, "cand_cache_invalidations", 0),
+            # batches served end-to-end by the fused device pipeline
+            # (ops/partitioned.py): nonzero proves host decode is off the
+            # per-batch path
+            "fused_batches": getattr(m, "fused_batches", 0),
         }
 
     def is_match(self, topic: str) -> bool:

@@ -50,8 +50,10 @@ HEARTBEAT_INTERVAL = 900.0    # after everything has completed
 MAX_HOURS = 11.5
 
 # per-config subprocess deadlines (seconds). cfg4/cfg5 build 10M-filter
-# tables (minutes of host work) before the first device touch.
-CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200}
+# tables (minutes of host work) before the first device touch; cfg11 is
+# the small-batch paired estimator (tiny table, many micro dispatches).
+CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200, 11: 1800}
+CONFIG_ORDER = (1, 2, 3, 11, 4, 5)  # cheap + diagnostic before the 10M builds
 SMOKE_TIMEOUT = 1200
 
 
@@ -75,13 +77,20 @@ def save_state(st: dict) -> None:
     STATE_PATH.write_text(json.dumps(st, indent=1))
 
 
-def run_sub(cmd: list[str], timeout: float) -> tuple[int, str, str]:
+def run_sub(cmd: list[str], timeout: float,
+            env: dict | None = None) -> tuple[int, str, str]:
     """Run a child in its own process group so a wedged device fetch can be
-    killed together with any grandchildren it spawned."""
+    killed together with any grandchildren it spawned. ``env`` entries
+    overlay the inherited environment (the fused-vs-unfused A/B runs)."""
     try:
+        child_env = None
+        if env:
+            child_env = dict(os.environ)
+            child_env.update(env)
         p = subprocess.Popen(
             cmd, cwd=str(REPO), stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, start_new_session=True,
+            env=child_env,
         )
         out, err = p.communicate(timeout=timeout)
         return p.returncode, out, err
@@ -101,18 +110,25 @@ def merge_snapshot(st: dict) -> None:
     run; the hunter re-merges after each config so a kill at any point
     leaves the union of everything measured so far."""
     configs: dict = {}
+    extras: dict = {}
     try:
         prior = json.loads(LAST_TPU.read_text())
         configs.update(prior.get("configs") or {})
+        if prior.get("smallbatch_paired"):
+            extras["smallbatch_paired"] = prior["smallbatch_paired"]
     except Exception:
         pass
-    for n in range(1, 6):
+    for n in CONFIG_ORDER:
         ck = HUNT_DIR / f"cfg{n}.json"
         if not ck.exists():
             continue
         try:
             one = json.loads(ck.read_text())
             configs.update(one.get("configs") or {})
+            # cfg11 emits its own artifact shape (per-stage small-batch
+            # attribution), carried alongside the configs table
+            if one.get("smallbatch_paired"):
+                extras["smallbatch_paired"] = one["smallbatch_paired"]
         except Exception as e:
             log(f"checkpoint cfg{n} unreadable: {e}")
     if not configs:
@@ -131,6 +147,7 @@ def merge_snapshot(st: dict) -> None:
         "unit": "topics/s",
         "vs_baseline": vsb,
         "configs": configs,
+        **extras,
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "source": "round-5 chip hunter (per-config checkpoints)",
     }
@@ -164,7 +181,7 @@ def chip_window(st: dict) -> None:
         # rc==1 (some step failed): still try the bench — the failing step
         # may be an optional path; the bench latches working variants
 
-    for n in range(1, 6):
+    for n in CONFIG_ORDER:
         if n in st["done_configs"]:
             continue
         log(f"bench --config {n} starting (timeout {CONFIG_TIMEOUT[n]}s)")
@@ -203,22 +220,37 @@ def chip_window(st: dict) -> None:
             log("chip unreachable after failure — back to hunting")
             return
 
-    # phase 2: everything measured once → spend the window on the roofline
-    # profile (VERDICT item 2) and a stream-sweep rerun at cfg3
+    # phase 2: everything measured once → the fused-vs-unfused A/B (same
+    # configs re-run with RMQTT_FUSED=0 / RMQTT_PACKED=0, checkpointed so
+    # the fused pipeline's on-chip win is a measured delta, not a model),
+    # then the roofline profiles
+    # the A/B legs run deliberately-degraded configs: RMQTT_BENCH_NO_PERSIST
+    # stops the child from merging crippled numbers into BENCH_LAST_TPU.json
+    # (their artifacts live only in the .chip_hunt checkpoints). cfg11 needs
+    # no unfused A/B leg — it is self-pairing (its unfused matcher is built
+    # with RMQTT_FUSED=0 internally).
     phase2 = [
+        ("ab_cfg3_unfused", [sys.executable, "bench.py", "--config", "3"],
+         4200, {"RMQTT_FUSED": "0", "RMQTT_BENCH_NO_PERSIST": "1"}),
+        ("ab_cfg3_legacy_tiles", [sys.executable, "bench.py", "--config", "3"],
+         4200, {"RMQTT_PACKED": "0", "RMQTT_BENCH_NO_PERSIST": "1"}),
         ("profile_cfg3", [sys.executable, "bench.py", "--config", "3",
-                          "--profile", str(HUNT_DIR / "xprof")], 4200),
+                          "--profile", str(HUNT_DIR / "xprof")], 4200, None),
         ("profile_cfg4", [sys.executable, "bench.py", "--config", "4",
-                          "--profile", str(HUNT_DIR / "xprof")], 7200),
+                          "--profile", str(HUNT_DIR / "xprof")], 7200, None),
     ]
-    if len(st["done_configs"]) == 5:
-        for name, cmd, tmo in phase2:
+    if all(n in st["done_configs"] for n in CONFIG_ORDER):
+        for name, cmd, tmo, env in phase2:
             if name in st["phase2_done"]:
                 continue
             log(f"phase2 {name} starting")
-            rc, out, err = run_sub(cmd, tmo)
+            rc, out, err = run_sub(cmd, tmo, env=env)
             log(f"phase2 {name} rc={rc}")
             if rc == 0:
+                for line in (out or "").strip().splitlines()[::-1]:
+                    if line.startswith("{"):
+                        (HUNT_DIR / f"{name}.json").write_text(line)
+                        break
                 st["phase2_done"].append(name)
                 save_state(st)
             else:
@@ -245,7 +277,8 @@ def main() -> None:
             merge_snapshot(st)
         else:
             log(f"probe #{st['probes']}: unreachable")
-        done = len(st["done_configs"]) == 5 and len(st["phase2_done"]) >= 2
+        done = (all(n in st["done_configs"] for n in CONFIG_ORDER)
+                and len(st["phase2_done"]) >= 4)
         time.sleep(HEARTBEAT_INTERVAL if done else PROBE_INTERVAL)
     log(f"hunter exiting after {MAX_HOURS}h "
         f"(probes={st['probes']}, windows={st['windows']}, "

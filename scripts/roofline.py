@@ -5,12 +5,18 @@ VERDICT r4 item 2 asked for the achievable ceiling as a NUMBER. This
 script builds the bench's filter tables (reduced or full), measures the
 real candidate-chunk distribution of the bench's topic streams, and
 computes the per-batch HBM traffic of the scan kernel from the actual
-device-tile layout (`ops.partitioned.pack_device_rows`):
+device-tile layouts — BOTH of them:
 
-    tile_bytes  = (L+3) * CHUNK * dtype_size        # one gathered tile
-    batch_bytes = B * NC_eff * tile_bytes           # the scan's gathers
-                + B * NC_eff * WPC * 4              # packed words out
-    ceiling     = B / (batch_bytes / HBM_BW)        # topics/s if HBM-bound
+- legacy int16/int32 field-major tiles (``ops.partitioned.pack_device_rows``)
+- bit-packed int32 byte-plane tiles (``pack_device_rows_packed``): per-level
+  local token ids at 1-2 bytes each + one metadata byte, grouped four byte
+  planes per int32 lane
+
+and the fused-pipeline deltas (the ``[B, NC*WPC]`` words array that no
+longer round-trips between two dispatches, and the route wire moving from
+2 B + host decode to 4 B final fids). The model itself lives in
+``rmqtt_tpu/bench/roofline_model.py`` so ``bench.py`` embeds the SAME
+numbers next to each measured config (modeled-vs-measured per run).
 
 HBM_BW defaults to v5e (819 GB/s); pass --bw to model other parts. The
 printout compares the ceiling with the standing measured rates so the
@@ -38,9 +44,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # model only — no device needed
 import numpy as np  # noqa: E402
 
 
-def build(name, filters, topics, batch):
-    from rmqtt_tpu.ops.partitioned import CHUNK, WORDS_PER_CHUNK, PartitionedTable
-    from rmqtt_tpu.core.topic import parse_shared
+def build(name, filters, topics, batch, bw):
+    from rmqtt_tpu.bench.roofline_model import model_table
+    from rmqtt_tpu.core.topic import parse_shared, split_levels
+    from rmqtt_tpu.ops.partitioned import CHUNK, PartitionedTable
 
     t = PartitionedTable()
     for f in filters:
@@ -48,35 +55,23 @@ def build(name, filters, topics, batch):
         t.add(stripped)
     t.compact()
     # measured candidate distribution over the real topic stream
-    ncs = []
-    for topic in topics[:4096]:
-        from rmqtt_tpu.core.topic import split_levels
-
-        ncs.append(len(t._candidates_for(split_levels(topic))))
-    ncs = np.asarray(ncs)
-    lvl = t.max_levels
-    dt = 4 if t._tok_wide else 2
-    tile = (lvl + 3) * CHUNK * dt
-    # NC split-dispatch buckets topics into tiers ≈ their own candidate
-    # count, so effective NC ≈ the stream mean padded to the tier ladder;
-    # without split it is the batch max padded to pow2
-    nc_eff = float(np.mean(ncs))
-    nc_pad = 1 << (int(ncs.max()) - 1).bit_length()
-    out_bytes = nc_eff * WORDS_PER_CHUNK * 4
-    per_topic = nc_eff * tile + out_bytes
-    return {
+    ncs = [len(t._candidates_for(split_levels(topic)))
+           for topic in topics[:4096]]
+    model = model_table(t, ncs, bw_gbps=bw)
+    layout = t.packed_layout()
+    model.update({
         "config": name,
         "filters": len(filters),
         "nchunks": t.nchunks,
-        "table_mb": round(t.nchunks * CHUNK * (lvl + 3) * dt / 1e6, 1),
-        "nc_mean": round(nc_eff, 2),
-        "nc_p99": int(np.percentile(ncs, 99)),
-        "nc_pad_nosplit": nc_pad,
-        "tile_bytes": tile,
-        "bytes_per_topic": int(per_topic),
-        "bytes_per_topic_nosplit": int(nc_pad * tile + out_bytes),
         "batch": batch,
-    }
+        "packed_layout": list(layout.widths) if layout is not None else None,
+        "table_mb_legacy": round(
+            t.nchunks * model["tile_bytes_legacy"] / 1e6, 1),
+        "table_mb_packed": (
+            round(t.nchunks * model["tile_bytes_packed"] / 1e6, 1)
+            if layout is not None else None),
+    })
+    return model
 
 
 def main():
@@ -96,34 +91,36 @@ def main():
     f1 = bench.gen_exact(rng, n1)
     t1 = [rng.choice(f1) if rng.random() < 0.5 else bench._tree_topic(rng, 4)
           for _ in range(4096)]
-    rows.append(build("cfg1_exact_1k", f1, t1, 4096))
+    rows.append(build("cfg1_exact_1k", f1, t1, 4096, args.bw))
     n2, nt2 = (100_000, 8192) if args.full else (20_000, 8192)
     f2 = bench.gen_single_plus(rng, n2)
     t2 = ["/".join(f"l{d}n{rng.randrange(400)}" for d in range(rng.randint(3, 5)))
           for _ in range(nt2)]
-    rows.append(build("cfg2_plus_100k", f2, t2, 8192))
+    rows.append(build("cfg2_plus_100k", f2, t2, 8192, args.bw))
     n3 = 1_000_000 if args.full else 100_000
     f3 = bench.gen_mixed(rng, n3)
     t3 = bench.gen_topics_uniform(rng, 8192)
-    rows.append(build("cfg3_mixed_1m", f3, t3, 16384))
+    rows.append(build("cfg3_mixed_1m", f3, t3, 16384, args.bw))
     n4 = 10_000_000 if args.full else 200_000
     f4 = bench.gen_mixed(rng, n4, shared_frac=0.1)
     t4 = bench.gen_topics_zipf(rng, 8192)
-    rows.append(build("cfg4_shared_10m_zipf", f4, t4, 8192))
+    rows.append(build("cfg4_shared_10m_zipf", f4, t4, 8192, args.bw))
 
-    bw = args.bw * 1e9
     print(f"\nHBM roofline @ {args.bw:.0f} GB/s "
           f"({'full' if args.full else 'reduced'} tables):")
     for r in rows:
-        ceil = bw / r["bytes_per_topic"]
-        ceil_ns = bw / r["bytes_per_topic_nosplit"]
-        r["ceiling_topics_per_sec"] = int(ceil)
-        r["ceiling_topics_per_sec_nosplit"] = int(ceil_ns)
-        print(f"  {r['config']:22s} table {r['table_mb']:8.1f} MB  "
-              f"nc_mean {r['nc_mean']:6.2f} (pad {r['nc_pad_nosplit']:4d})  "
-              f"{r['bytes_per_topic']:>8d} B/topic  "
-              f"ceiling {ceil/1e6:8.2f}M topics/s "
-              f"(no-split {ceil_ns/1e6:.2f}M)")
+        print(
+            f"  {r['config']:22s} "
+            f"tiles {r['tile_bytes_legacy']:5d}→{r['tile_bytes_packed'] or 0:5d} B "
+            f"({r['packed_tile_reduction_x'] or 0:.2f}x)  "
+            f"nc_mean {r['nc_mean']:6.2f}  "
+            f"{r['bytes_per_topic_legacy']:>8d}→{r['bytes_per_topic']:>7d} B/topic "
+            f"({r['hbm_bytes_reduction_x']:.2f}x)  "
+            f"ceiling {r['ceiling_topics_per_sec_legacy'] / 1e6:6.2f}→"
+            f"{r['ceiling_topics_per_sec'] / 1e6:.2f}M topics/s"
+        )
+    print("\nfused pipeline (per topic, modeled): words round-trip "
+          "eliminated; wire 2B/route + host decode → 4B/route final fids")
     out = REPO / "ROOFLINE.json"
     out.write_text(json.dumps(
         {"hbm_gbps": args.bw, "full_tables": args.full, "configs": rows},

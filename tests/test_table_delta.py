@@ -437,6 +437,7 @@ def test_device_stats_surface():
     stats = svc.stats()
     for key in ("routing_uploads", "routing_delta_uploads",
                 "routing_upload_bytes", "routing_compactions",
-                "routing_compact_ms_total", "routing_cand_cache_invalidations"):
+                "routing_compact_ms_total", "routing_cand_cache_invalidations",
+                "routing_fused_batches"):
         assert key in stats and isinstance(stats[key], (int, float)), key
     assert stats["routing_uploads"] >= 1
