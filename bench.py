@@ -1328,6 +1328,91 @@ def run_smallbatch_config(name, rng, reduced):
     return res
 
 
+def run_devprof_overhead_config(name, rng, reduced):
+    """Config 12: device-profiler overhead, cfg7-style order-symmetric
+    paired estimator.
+
+    Same matcher, same batches; leg A runs with ``device_profile`` ON
+    (the global DEVPROF registry + flight ring + the matcher's
+    stage_timing — exactly what the [observability] knob enables), leg B
+    with both off. Order alternates per pair so a host-noise stall lands
+    on both legs equally; the median pair ratio bounds the enabled cost.
+    The profiler adds only host work (no new jit signatures), so one
+    warmup covers both legs. Acceptance: overhead ≤ 2% — a standalone
+    ``--config 12`` run exits nonzero past the bound so CI can gate on it."""
+    from rmqtt_tpu.broker.devprof import DEVPROF
+    from rmqtt_tpu.broker.telemetry import Telemetry
+
+    n, pairs, bs = (5_000, 64, 128) if reduced else (50_000, 192, 512)
+    filters = gen_mixed(rng, n)
+    # batches draw from a BOUNDED topic pool and every batch is warmed
+    # once below: the first match of a fresh batch pays candidate-cache
+    # misses (~20x the steady encode), which would otherwise land on
+    # whichever leg runs first and swamp the profiler cost being measured
+    pool = gen_topics_uniform(rng, 4096)
+    log(f"[{name}] {n} subs, {pairs} pairs of batches of {bs}")
+    table, fids = build_tpu_table(filters, "partitioned")
+    matcher = make_matcher(table)
+    batches = [[pool[rng.randrange(len(pool))] for _ in range(bs)]
+               for _ in range(pairs)]
+    prior_enabled = DEVPROF.enabled
+    prior_tele = DEVPROF.telemetry
+    # a throwaway telemetry registry so storm/floor annotations (if any)
+    # pay their real cost without touching the process-global slow ring
+    DEVPROF.configure(enabled=True, telemetry=Telemetry(enabled=True))
+    try:
+        for b in batches:  # compile + warm every batch's candidate sets
+            matcher.match(b)
+        lat = {"on": [], "off": []}
+        ratios = []
+        t0 = time.perf_counter()
+        for i, b in enumerate(batches):
+            def one(key, enabled):
+                DEVPROF.enabled = enabled
+                matcher.stage_timing = enabled
+                t1 = time.perf_counter()
+                matcher.match(b)
+                lat[key].append(time.perf_counter() - t1)
+            if i % 2:
+                one("off", False)
+                one("on", True)
+            else:
+                one("on", True)
+                one("off", False)
+            ratios.append(lat["on"][-1] / max(1e-9, lat["off"][-1]))
+        wall = time.perf_counter() - t0
+    finally:
+        DEVPROF.configure(enabled=prior_enabled, telemetry=prior_tele)
+        matcher.stage_timing = False
+    ratios.sort()
+
+    def p(key, q):
+        ls = sorted(lat[key])
+        return round(ls[min(len(ls) - 1, int(len(ls) * q))] * 1e3, 3)
+
+    median_ratio = ratios[len(ratios) // 2]
+    overhead_pct = round((median_ratio - 1.0) * 100.0, 2)
+    res = {
+        "name": name,
+        "table_size": len(fids),
+        "batch": bs,
+        "pairs": len(batches),
+        "topics_per_sec": round(2 * len(batches) * bs / wall, 1),
+        "on_p50_ms": p("on", 0.5), "on_p99_ms": p("on", 0.99),
+        "off_p50_ms": p("off", 0.5), "off_p99_ms": p("off", 0.99),
+        "median_pair_ratio": round(median_ratio, 4),
+        "overhead_pct": overhead_pct,
+        "bound_pct": 2.0,
+        "ok": overhead_pct <= 2.0,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] profiler ON p50 {res['on_p50_ms']}ms vs OFF "
+        f"{res['off_p50_ms']}ms (median pair ratio {res['median_pair_ratio']}x"
+        f" = {overhead_pct}% overhead, bound 2%) → "
+        f"{'OK' if res['ok'] else 'FAIL'}")
+    return res
+
+
 def run_failover_config(name, rng, reduced):
     """Config 10: device-plane failover soak (broker/failover.py).
 
@@ -1538,7 +1623,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-10")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-12")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -1576,6 +1661,32 @@ def main():
     _ON_TPU = platform == "tpu"
     log(f"jax devices: {jax.devices()} (platform={platform})")
 
+    # device-plane profiler (broker/devprof.py): every bench run carries
+    # the devprof snapshot in its JSON, and a FAILED config persists a
+    # flight-recorder dump so the next TPU window is diagnosable even when
+    # the run dies (the postmortem cfg4/cfg5 never got)
+    from rmqtt_tpu.broker.devprof import DEVPROF
+
+    devprof_dir = os.path.join(os.path.dirname(__file__), ".devprof")
+    DEVPROF.configure(enabled=True, dump_dir=devprof_dir)
+    # the chip hunter TERMs a wedged child before KILLing it: freeze the
+    # flight recorder on the way out so even a timed-out config leaves an
+    # artifact (SIGKILL leaves nothing — that is why the TERM comes first).
+    # The handler ONLY raises: signal handlers run on the main thread
+    # between bytecodes, and the interrupted frame may be inside a
+    # `with DEVPROF._lock:` block — dumping here would deadlock on the
+    # non-reentrant lock. The KeyboardInterrupt unwinds those `with`
+    # blocks (releasing the lock) and guarded()'s handler does the dump.
+    import signal as _signal
+
+    def _on_term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
     results = {}
 
     def want(i):
@@ -1589,12 +1700,13 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 11
+            return i <= 12
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
-        # (overload soak), cfg9 (churn soak / delta uploads) and cfg11
-        # (small-batch stage attribution) are cheap and always informative
-        return i <= 3 or i in (6, 7, 8, 9, 10, 11) or args.full or on_tpu
+        # (overload soak), cfg9 (churn soak / delta uploads), cfg11
+        # (small-batch stage attribution) and cfg12 (device-profiler
+        # overhead bound) are cheap and always informative
+        return i <= 3 or i in (6, 7, 8, 9, 10, 11, 12) or args.full or on_tpu
 
     failures = {}
     if args.profile:
@@ -1617,9 +1729,17 @@ def main():
             interrupted = True
             failures[name] = "KeyboardInterrupt (timeout/wedge?)"
             log(f"{name} INTERRUPTED — emitting the configs already measured")
+            # safe here: the interrupt already unwound any profiler-lock
+            # `with` blocks on this thread (see the SIGTERM handler note)
+            DEVPROF.dump_to(os.path.join(devprof_dir, f"{name}.json"),
+                            f"bench-config-interrupted: {name}")
         except BaseException as e:
             failures[name] = f"{type(e).__name__}: {e}"
             log(f"{name} FAILED: {failures[name]}")
+            # persist the flight recorder for the dead config: the artifact
+            # that makes a failed chip run diagnosable after the window
+            DEVPROF.dump_to(os.path.join(devprof_dir, f"{name}.json"),
+                            f"bench-config-failed: {failures[name]}")
             if on_tpu and not tpu_available(probe_timeout=30.0, retries=1):
                 # the accelerator wedged mid-run: later configs would spend
                 # minutes building tables only to hang on their first device
@@ -1716,6 +1836,13 @@ def main():
 
         guarded("cfg11_smallbatch_paired", cfg11)
 
+    if want(12):
+        def cfg12():
+            return run_devprof_overhead_config("cfg12_devprof_overhead", rng,
+                                               reduced)
+
+        guarded("cfg12_devprof_overhead", cfg12)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -1725,6 +1852,32 @@ def main():
     churn_res = results.pop("cfg9_churn_soak", None)
     failover_res = results.pop("cfg10_failover_soak", None)
     smallbatch_res = results.pop("cfg11_smallbatch_paired", None)
+    devprof_res = results.pop("cfg12_devprof_overhead", None)
+    # every bench JSON carries the device-plane profiler snapshot + the
+    # tail of the flight ring (satellite of the devprof PR: on-chip runs
+    # become diagnosable from the artifact alone)
+    devprof_embed = {"devprof": {**DEVPROF.snapshot(),
+                                 "flight": DEVPROF.flight()[-16:]}}
+    if (not results and devprof_res is not None and smallbatch_res is None
+            and failover_res is None and churn_res is None
+            and overload_res is None and tele_res is None
+            and cache_res is None):
+        # a --config 12 run: its own artifact shape; the >2% bound FAILS
+        # the run (exit 1) so CI and the chip hunter can gate on it
+        print(json.dumps({
+            "metric": "devprof_overhead_pct[cfg12_devprof_overhead]",
+            "value": devprof_res["overhead_pct"],
+            "unit": "pct_vs_off",
+            "vs_baseline": devprof_res["overhead_pct"],
+            "ok": devprof_res["ok"],
+            "platform": platform,
+            "devprof_overhead": devprof_res,
+            **devprof_embed,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not devprof_res["ok"]:
+            sys.exit(1)
+        return
     if (not results and smallbatch_res is not None and failover_res is None
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None):
@@ -1825,6 +1978,13 @@ def main():
         }))
         return
 
+    if devprof_res is not None and not devprof_res["ok"]:
+        # surfaced as a failed config in the merged artifact; a standalone
+        # --config 12 run (the CI gate) exits nonzero above
+        failures["cfg12_devprof_overhead"] = (
+            f"profiler overhead {devprof_res['overhead_pct']}% > "
+            f"{devprof_res['bound_pct']}% bound")
+
     # headline = the largest routing config that ran
     if not results:
         print(
@@ -1905,6 +2065,11 @@ def main():
         # the cfg1 regime, fused vs unfused (ops/partitioned.py)
         **({"smallbatch_paired": smallbatch_res}
            if smallbatch_res is not None else {}),
+        # device-profiler overhead bound (cfg12): enabled-vs-disabled cost
+        # of the [observability] device_profile knob (broker/devprof.py)
+        **({"devprof_overhead": devprof_res}
+           if devprof_res is not None else {}),
+        **devprof_embed,
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),
     }

@@ -121,6 +121,17 @@ class BrokerConfig:
     trace_sample: float = 0.01  # probability a publish is head-sampled
     trace_max_traces: int = 512  # committed traces kept (FIFO eviction)
     trace_max_spans: int = 64  # spans kept per trace
+    # device-plane profiler + flight recorder (broker/devprof.py, same
+    # [observability] section): jit shape-key registry (compile hit vs
+    # trace, retrace-storm detection), HBM occupancy model, dispatch
+    # rollup time series and a bounded flight ring that auto-dumps on
+    # failover trips / fused-verify disagreement / retrace storms.
+    # device_profile=false keeps every instrumented jit seam at one
+    # attribute check (no keys, no timestamps, no ring appends).
+    device_profile: bool = True
+    device_ring: int = 256  # flight-recorder record cap
+    device_storm_n: int = 8  # traces within the window that flag a storm
+    device_storm_window: float = 10.0  # seconds
     # overload-control subsystem (broker/overload.py, [overload] config
     # section): watermark-driven NORMAL/ELEVATED/CRITICAL states, token-
     # bucket admission, degradation tiers, circuit-broken egress. Disabled
@@ -351,6 +362,26 @@ class ServerContext:
                 metrics=self.metrics,
                 telemetry=self.telemetry,
             )
+        # device-plane profiler + flight recorder (broker/devprof.py):
+        # process-global like the failpoint registry (the jit caches it
+        # models are process-global); the last-constructed context owns the
+        # telemetry ring / HBM provider wiring. Enabling also turns on the
+        # matcher's per-stage wall attribution (PR9 stage_timing) so the
+        # routing_stage_* gauges and flight records carry stage deltas.
+        from rmqtt_tpu.broker.devprof import DEVPROF
+
+        DEVPROF.configure(
+            enabled=self.cfg.device_profile,
+            ring=self.cfg.device_ring,
+            storm_n=self.cfg.device_storm_n,
+            storm_window=self.cfg.device_storm_window,
+            telemetry=self.telemetry,
+            hbm_provider=getattr(router, "device_hbm", None),
+        )
+        if self.cfg.device_profile:
+            rmatcher = getattr(router, "matcher", None)
+            if rmatcher is not None and hasattr(rmatcher, "stage_timing"):
+                rmatcher.stage_timing = True
 
     @property
     def handshaking(self) -> int:
@@ -390,6 +421,17 @@ class ServerContext:
         await self.overload.stop()
         await self.routing.stop()
         await self.delayed.stop()
+        # unhook THIS context from the process-global profiler: a bound
+        # hbm_provider would otherwise pin the router (and its whole match
+        # table / device arrays) for the process lifetime and keep serving
+        # a dead broker's HBM occupancy on /metrics scrapes
+        from rmqtt_tpu.broker.devprof import DEVPROF
+
+        if DEVPROF.telemetry is self.telemetry:
+            DEVPROF.configure(telemetry=None)
+        hp = DEVPROF.hbm_provider
+        if hp is not None and getattr(hp, "__self__", None) is self.router:
+            DEVPROF.configure(hbm_provider=None)
 
     def stats(self) -> Stats:
         s = Stats()
@@ -440,6 +482,20 @@ class ServerContext:
         s.cluster_fence_kicks = self.metrics.get("cluster.fence_kicks")
         s.cluster_anti_entropy_runs = self.metrics.get(
             "cluster.anti_entropy.runs")
+        # device-plane profiler gauges (broker/devprof.py): jit registry
+        # totals + retrace storms + modeled HBM residency (fleet-summable)
+        from rmqtt_tpu.broker.devprof import DEVPROF
+
+        s.device_jit_traces = DEVPROF.traces
+        s.device_jit_cache_hits = DEVPROF.cache_hits
+        s.device_retrace_storms = DEVPROF.storms
+        hbm = getattr(self.router, "device_hbm", None)
+        if callable(hbm):
+            try:
+                s.device_hbm_modeled_mb = round(
+                    (hbm() or {}).get("total_bytes", 0) / 2**20, 3)
+            except Exception:
+                pass
         # process RSS (utils/sysmon.py — same probe the overload sampler
         # uses); sums to a cluster memory total in /stats/sum
         from rmqtt_tpu.utils.sysmon import rss_mb

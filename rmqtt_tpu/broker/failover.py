@@ -270,6 +270,16 @@ class DeviceFailover:
                 self.metrics.inc("routing.failover.failovers")
             log.warning("device routing plane FAILED OVER to host trie "
                         "(reason=%s breaker=%s)", reason, self.breaker.snapshot())
+            # postmortem artifact: freeze the flight recorder at the moment
+            # the device plane was declared dead (broker/devprof.py) — the
+            # last K dispatch records + compile registry + HBM model are
+            # exactly what the cfg4/cfg5 deaths never left behind
+            try:
+                from rmqtt_tpu.broker.devprof import DEVPROF
+
+                DEVPROF.auto_dump("failover_trip")
+            except Exception:  # pragma: no cover - dump must never block failover
+                pass
             # start the clock-driven probe pacer (see _pace); transitions
             # to host always happen on the event loop (dispatch/complete
             # coroutines), so a running loop is available

@@ -232,6 +232,7 @@ class HttpApi:
                 "/api/v1/metrics", "/api/v1/metrics/sum",
                 "/api/v1/latency", "/api/v1/latency/sum",
                 "/api/v1/slo", "/api/v1/slo/sum",
+                "/api/v1/device", "/api/v1/device/sum",
                 "/api/v1/overload",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
                 "/api/v1/traces", "/api/v1/traces/slow",
@@ -401,6 +402,29 @@ class HttpApi:
             # stage histograms + slow-op ring (broker/telemetry.py);
             # shape-stable with telemetry disabled (zero-count stages)
             return 200, {"node": ctx.node_id, **ctx.telemetry.snapshot()}, J
+        if path == "/api/v1/device/sum":
+            # cluster-wide device plane (broker/devprof.py): counters sum,
+            # pad waste recomputes from the summed totals, HBM bytes sum to
+            # a fleet total (what=device DATA query per peer)
+            from rmqtt_tpu.broker.devprof import DEVPROF, DeviceProfiler
+
+            local = DEVPROF.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "device"},
+                lambda r: [r["device"]] if "device" in r else [],
+            )
+            return 200, DeviceProfiler.merge_snapshots(local, peers), J
+        if path == "/api/v1/device":
+            # device-plane profiler + flight recorder (broker/devprof.py):
+            # compile/retrace registry, HBM occupancy model vs live arrays,
+            # dispatch rollup time series; ?flight=1 appends the raw ring.
+            # Shape-stable with the profiler disabled (zeros everywhere).
+            from rmqtt_tpu.broker.devprof import DEVPROF
+
+            body_out = {"node": ctx.node_id, **DEVPROF.snapshot()}
+            if q.get("flight", ["0"])[0] not in ("0", "", "false"):
+                body_out["flight"] = DEVPROF.flight()
+            return 200, body_out, J
         if path == "/api/v1/slo/sum":
             # cluster-wide SLO: per-objective (good, total) pairs sum
             # across nodes (cumulative + both windows), burn rates
@@ -631,6 +655,11 @@ class HttpApi:
             lines.append(
                 f'rmqtt_failpoint_triggers_total{{{labels},'
                 f'site="{site}"}} {snap["triggers"]}')
+        # device-plane profiler families (broker/devprof.py): jit traces /
+        # cache hits / retrace storms / pad waste / modeled HBM bytes
+        from rmqtt_tpu.broker.devprof import DEVPROF
+
+        lines.extend(DEVPROF.prometheus_lines(labels))
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         # SLO gauges + good/bad event counters (broker/slo.py)
@@ -658,6 +687,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 <div class="cards" id="stats"></div>
 <h2>SLO</h2><div class="cards" id="slo"></div>
 <h2>Overload</h2><div class="cards" id="overload"></div>
+<h2>Device plane</h2><div class="cards" id="device"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
 <th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
@@ -673,7 +703,11 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_cache_invalidations","routing_cache_evictions",
  "routing_cache_door_rejects","routing_uploads","routing_delta_uploads",
  "routing_upload_bytes","routing_compactions","routing_compact_ms_total",
- "routing_cand_cache_invalidations","routing_failover_state",
+ "routing_cand_cache_invalidations","routing_fused_batches",
+ "routing_stage_encode_ms_total","routing_stage_dispatch_ms_total",
+ "routing_stage_fetch_ms_total","routing_stage_decode_ms_total",
+ "device_jit_traces","device_jit_cache_hits","device_retrace_storms",
+ "device_hbm_modeled_mb","routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
  "routing_device_failures","slo_state","slo_transitions","rss_mb"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
@@ -719,6 +753,18 @@ async function tick(){
    `<div class="card"><div class="v">${esc(adm.connect_refused??0)}</div><div class="k">connects refused</div></div>`+
    Object.entries(brks).map(([n,b])=>
     `<div class="card"><div class="v"${b.state!=="closed"?' style="color:#b00020"':''}>${esc(b.state)}</div><div class="k">breaker ${esc(n)}</div></div>`).join("");
+  const dev=await j("/api/v1/device");
+  const dc=dev.compile||{},dd=dev.dispatch||{},dh=dev.hbm||{};
+  document.getElementById("device").innerHTML=
+   (dev.enabled?"":`<div class="card"><div class="v">off</div><div class="k">device profiler disabled</div></div>`)+
+   `<div class="card"><div class="v">${esc(dc.traces??0)}</div><div class="k">jit traces</div></div>`+
+   `<div class="card"><div class="v">${esc(dc.cache_hits??0)}</div><div class="k">compile cache hits</div></div>`+
+   `<div class="card"><div class="v"${(dc.storms??0)?' style="color:#b00020"':''}>${esc(dc.storms??0)}</div><div class="k">retrace storms</div></div>`+
+   `<div class="card"><div class="v">${esc(dd.dispatches??0)}</div><div class="k">device dispatches</div></div>`+
+   `<div class="card"><div class="v">${esc(((dd.pad_waste??0)*100).toFixed(1))}%</div><div class="k">pad waste (floor ${esc(dd.pad_floor??1)})</div></div>`+
+   `<div class="card"><div class="v">${esc(dd.p99_ms??0)}ms</div><div class="k">dispatch p99 (recent)</div></div>`+
+   `<div class="card"><div class="v">${esc(((dh.modeled_bytes??0)/1048576).toFixed(1))}MB</div><div class="k">HBM modeled (${esc(dh.layout??"n/a")})</div></div>`+
+   `<div class="card"><div class="v">${esc(dd.fused??0)}/${esc(dd.fallback??0)}</div><div class="k">fused / fallback</div></div>`;
   const lat=await j("/api/v1/latency");
   const hs=lat.histograms||{};
   document.getElementById("latency").innerHTML=

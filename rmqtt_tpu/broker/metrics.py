@@ -79,6 +79,22 @@ class Stats:
         self.routing_compactions = 0
         self.routing_compact_ms_total = 0.0  # cumulative → summed, not averaged
         self.routing_cand_cache_invalidations = 0
+        self.routing_fused_batches = 0
+        # per-stage device dispatch attribution (PR9 stage_timing promoted
+        # to the live surface via XlaRouter.device_stats): cumulative ms,
+        # _total suffix → summed in /stats/sum like compact_ms_total
+        self.routing_stage_encode_ms_total = 0.0
+        self.routing_stage_dispatch_ms_total = 0.0
+        self.routing_stage_fetch_ms_total = 0.0
+        self.routing_stage_decode_ms_total = 0.0
+        # device-plane profiler gauges (broker/devprof.py), filled by
+        # ServerContext.stats(): jit shape-registry totals, retrace storms,
+        # and the modeled HBM residency (sums to a fleet total in
+        # /stats/sum); zeros with the profiler off or no device router
+        self.device_jit_traces = 0
+        self.device_jit_cache_hits = 0
+        self.device_retrace_storms = 0
+        self.device_hbm_modeled_mb = 0.0
         # latency percentile gauges (broker/telemetry.py histograms),
         # overwritten from RoutingService.stats(); the `_ms` suffix marks
         # average-mode for cluster /stats/sum merging (like `_ema`) —

@@ -161,6 +161,14 @@ class RoutingService:
             "routing_compact_ms_total": d.get("compact_ms", 0.0),
             "routing_cand_cache_invalidations": d.get("cand_cache_invalidations", 0),
             "routing_fused_batches": d.get("fused_batches", 0),
+            # per-stage device dispatch attribution (PR9 stage_timing via
+            # XlaRouter.device_stats): cumulative ms → _total suffix (summed
+            # in /stats/sum); zeros for trie/native routers and while
+            # stage_timing is off, so the surface stays shape-stable
+            "routing_stage_encode_ms_total": d.get("stage_encode_ms_total", 0.0),
+            "routing_stage_dispatch_ms_total": d.get("stage_dispatch_ms_total", 0.0),
+            "routing_stage_fetch_ms_total": d.get("stage_fetch_ms_total", 0.0),
+            "routing_stage_decode_ms_total": d.get("stage_decode_ms_total", 0.0),
             # device-plane failover gauges (broker/failover.py): zeros when
             # failover is not wired so the surface stays shape-stable.
             # state: 0 = device (healthy), 1 = host fallback, 2 = probing

@@ -357,6 +357,12 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             # per-node SLO snapshot for /api/v1/slo/sum; (good, total)
             # pairs sum per objective on the requesting node
             return {"slo": ctx.slo.snapshot()}
+        if what == "device":
+            # per-node device-plane profiler snapshot for
+            # /api/v1/device/sum (broker/devprof.py merge_snapshots)
+            from rmqtt_tpu.broker.devprof import DEVPROF
+
+            return {"device": DEVPROF.snapshot()}
         if what == "traces":
             # trace-API cluster fetch (broker/tracing.py): by id → this
             # node's spans for that trace (the requester stitches);

@@ -343,6 +343,9 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
     # trace_* configure the per-publish tracing layer (broker/tracing.py):
     # head-sampling probability + bounded trace/span store caps (tracing
     # shares enable and slow_ms — a slow publish is always recorded)
+    # device_* knobs configure the device-plane profiler + flight recorder
+    # (broker/devprof.py): jit shape-key registry / retrace-storm detector,
+    # dispatch rollups, bounded flight ring + auto-dump triggers
     _apply_section(tree, "observability", {
         "enable": ("telemetry_enable", bool),
         "slow_ms": ("telemetry_slow_ms", float),
@@ -350,6 +353,10 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "trace_sample": ("trace_sample", float),
         "trace_max_traces": ("trace_max_traces", int),
         "trace_max_spans": ("trace_max_spans", int),
+        "device_profile": ("device_profile", bool),
+        "device_ring": ("device_ring", int),
+        "recompile_storm_n": ("device_storm_n", int),
+        "recompile_storm_window": ("device_storm_window", float),
     }, broker_kwargs)
     # [slo] — the live SLO engine (broker/slo.py): error budgets +
     # multi-window burn rates over the telemetry histograms and drop

@@ -32,6 +32,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -45,6 +46,32 @@ from rmqtt_tpu.utils.failpoints import FAILPOINTS
 #: TpuMatcher, the sharded variants): fires when an HBM refresh — delta
 #: scatter or full pack+put — is about to run (utils/failpoints.py)
 _FP_UPLOAD = FAILPOINTS.register("device.upload")
+
+#: device-plane profiler (broker/devprof.py): every jit entry seam below
+#: reports hit-vs-trace through it when enabled; call sites guard on
+#: ``_DEVPROF.enabled`` so the disabled cost is one attribute check
+from rmqtt_tpu.broker.devprof import DEVPROF as _DEVPROF
+
+
+def _pj(kernel: str, fn, *args, **kwargs):
+    """One PROFILED jit-seam call — only reached when the device profiler
+    is enabled (sites use ``_pj(...) if _DEVPROF.enabled else <direct>``).
+    The shape key mirrors jax's own executable-cache signature, so a
+    never-seen key is a trace+compile by construction and the timed wall
+    of that first call brackets its cost (jit traces synchronously).
+
+    ``_key_extra`` (reserved, not forwarded to ``fn``) appends static
+    state that is baked into the CALLABLE rather than its arguments —
+    e.g. the sharded per-budget step closures, where arg shapes alone are
+    identical across budget regrows but each regrow is a real recompile."""
+    extra = kwargs.pop("_key_extra", None)
+    t0 = time.perf_counter_ns()
+    out = fn(*args, **kwargs)
+    key = _DEVPROF.key_of(args, kwargs)
+    if extra is not None:
+        key = key + (extra,)
+    _DEVPROF.note_jit(kernel, key, time.perf_counter_ns() - t0)
+    return out
 
 from rmqtt_tpu.core.topic import HASH, PLUS, is_metadata, split_levels
 from rmqtt_tpu.ops.encode import (
@@ -1758,6 +1785,15 @@ class PartitionedMatcher:
         # sticky small-batch pad floor (prewarm): tiny batches pad UP to one
         # already-compiled shape instead of compiling shapes 1/2/4/... each
         self._pad_floor = 1
+        # device-plane profiler glue (broker/devprof.py): submit-half flight
+        # records awaiting their complete half, matched by handle IDENTITY
+        # (so _complete_segmented's recursive sub-completes never consume a
+        # top-level record); bounded — an abandoned handle flushes oldest.
+        # The lock covers append vs scan: pipelined submits and completes
+        # run on different executor threads (RoutingService), and iterating
+        # a deque under a concurrent append raises
+        self._prof_pending: deque = deque()
+        self._prof_lock = threading.Lock()
         # per-stage wall-clock attribution (cfg11): zero-overhead when off
         self.stage_timing = False
         self.stage_ns = {"encode": 0, "dispatch": 0, "fetch": 0, "decode": 0}
@@ -1893,6 +1929,11 @@ class PartitionedMatcher:
             return None  # pallas grid needs a BT-multiple batch
         self._maybe_decide_pallas(dev, ttok, tlen, tdollar, chunk_ids)
         if self._pallas:
+            if _DEVPROF.enabled:
+                return _pj("words_pallas", _jit_words_pallas,
+                           dev, ttok, tlen, tdollar, chunk_ids,
+                           layout=self._dev_playout,
+                           interpret=self._pallas_interpret)
             return _jit_words_pallas(
                 dev, ttok, tlen, tdollar, chunk_ids,
                 layout=self._dev_playout, interpret=self._pallas_interpret,
@@ -2000,8 +2041,10 @@ class PartitionedMatcher:
         self._dev_fid_map = fid_map
         self.uploads += 1
         self.full_uploads += 1
-        self.upload_bytes += packed.nbytes + (
-            fids2d.nbytes if fids2d is not None else 0)
+        nb = packed.nbytes + (fids2d.nbytes if fids2d is not None else 0)
+        self.upload_bytes += nb
+        if _DEVPROF.enabled:
+            _DEVPROF.note_upload("full", nb)
         return self._dev_arrays
 
     def _want_fids(self) -> bool:
@@ -2045,18 +2088,33 @@ class PartitionedMatcher:
                 idx, vals = _pad_scatter_pow2(
                     np.asarray(cids, dtype=np.int32), tiles
                 )
-                self._dev_arrays = self._dev_arrays.at[idx].set(vals)
+                # pow2-padded scatter: one compiled executable per pow2
+                # dirty-chunk bucket — the "one compiled scatter under
+                # steady churn" invariant the profiler makes checkable
+                self._dev_arrays = (
+                    _pj("delta_scatter",
+                        lambda a, i, v: a.at[i].set(v),
+                        self._dev_arrays, idx, vals)
+                    if _DEVPROF.enabled else
+                    self._dev_arrays.at[idx].set(vals))
                 if ftiles is not None:
                     fidx, fvals = _pad_scatter_pow2(
                         np.asarray(cids, dtype=np.int32), ftiles
                     )
-                    self._dev_fids = self._dev_fids.at[fidx].set(fvals)
+                    self._dev_fids = (
+                        _pj("delta_scatter_fids",
+                            lambda a, i, v: a.at[i].set(v),
+                            self._dev_fids, fidx, fvals)
+                        if _DEVPROF.enabled else
+                        self._dev_fids.at[fidx].set(fvals))
             else:
                 self._apply_segment_delta(t, cids, tiles, ftiles)
             self.uploads += 1
             self.delta_uploads += 1
-            self.upload_bytes += tiles.nbytes + (
-                ftiles.nbytes if ftiles is not None else 0)
+            nb = tiles.nbytes + (ftiles.nbytes if ftiles is not None else 0)
+            self.upload_bytes += nb
+            if _DEVPROF.enabled:
+                _DEVPROF.note_upload("delta", nb)
         self._dev_version = t.version
         self._dev_fid_map = t._fid_of_row
         return True
@@ -2127,7 +2185,51 @@ class PartitionedMatcher:
         """Encode + dispatch WITHOUT fetching: jax dispatch is async, so the
         caller can submit batch N+1 (host encode) while N computes on
         device, then ``match_complete`` each handle in order. This is how
-        the bench pipelines over a high-latency dispatch path."""
+        the bench pipelines over a high-latency dispatch path.
+
+        With the device profiler on (broker/devprof.py), the submit half
+        opens a flight-recorder record (shape kind, compile hit-vs-trace,
+        batch/padded rows) that ``match_complete`` closes with the fetch/
+        decode stage deltas; off = one attribute check."""
+        if not _DEVPROF.enabled:
+            return self._submit_impl(topics, pad_to_pow2)
+        # the traces delta is best-effort under concurrency: another
+        # matcher tracing between the marks can mislabel this record
+        # 'trace' — the registry totals themselves stay exact
+        tr0 = _DEVPROF.traces
+        sn0 = dict(self.stage_ns) if self.stage_timing else None
+        t0 = time.perf_counter_ns()
+        meta: dict = {}
+        h = self._submit_impl(topics, pad_to_pow2, _meta=meta)
+        traces = _DEVPROF.traces - tr0
+        padded = meta.get("padded", len(topics))
+        rec = {
+            "ts": round(time.time(), 3),
+            "kind": h[0],
+            "batch": len(topics),
+            "padded": padded,
+            "pad_waste": round(1.0 - len(topics) / padded, 4)
+            if padded else 0.0,
+            "traces": traces,
+            "compile": "trace" if traces else "hit",
+            "submit_ns": time.perf_counter_ns() - t0,
+        }
+        old = None
+        with self._prof_lock:
+            self._prof_pending.append((h, rec, sn0))
+            if len(self._prof_pending) > 16:
+                # abandoned handle (caller never completed it): flush so the
+                # record still reaches the ring and the deque stays bounded
+                _h, old, _sn = self._prof_pending.popleft()
+        if old is not None:
+            # ring-only: it never completed, so it is not a dispatch — and
+            # it must not inherit the CURRENT publish's trace id or land
+            # in the current rollup bucket
+            _DEVPROF.note_abandoned(old)
+        return h
+
+    def _submit_impl(self, topics: Sequence[str], pad_to_pow2: bool = True,
+                     _meta: Optional[dict] = None):
         t = self.table
         if t.compact_async:
             # churn-triggered background compaction: the rebuild runs on
@@ -2159,6 +2261,11 @@ class PartitionedMatcher:
                 padded = self._pad_floor
         else:
             padded = b
+        if _meta is not None:
+            # profiler's pad-waste source — an out-param, not an instance
+            # attribute: concurrent submits on one matcher (pipelined
+            # executor threads) must not cross-attribute their padding
+            _meta["padded"] = padded
         t_enc = time.perf_counter_ns() if self.stage_timing else 0
         want_groups = self.compact_mode == "global"
         while True:
@@ -2201,10 +2308,13 @@ class PartitionedMatcher:
                     return handle
             words = self._words(dev, tt, tlen, tdollar, chunk_ids)
             lay = self._dev_playout
+            prof = _DEVPROF.enabled
             if self.compact_mode == "global":
                 if words is not None:
                     g = self._budget_for(padded, _nc)
-                    packed = _compact_global(words, budget=g)
+                    packed = (
+                        _pj("compact_global", _compact_global, words, budget=g)
+                        if prof else _compact_global(words, budget=g))
                     return ("g", b, chunk_ids, words,
                             (dev, tt, tlen, tdollar, None, lay), packed, g, 0,
                             snap)
@@ -2216,26 +2326,38 @@ class PartitionedMatcher:
                 grouped = self._group_inputs(enc[5], chunk_ids)
                 g = self._budget_for(padded, _nc)
                 if grouped is None:  # batch doesn't dedup; plain upload
-                    packed = _match_global(
-                        dev, tt, tlen, tdollar, chunk_ids, budget=g, layout=lay
-                    )
+                    packed = (
+                        _pj("match_global", _match_global, dev, tt, tlen,
+                            tdollar, chunk_ids, budget=g, layout=lay)
+                        if prof else _match_global(
+                            dev, tt, tlen, tdollar, chunk_ids, budget=g,
+                            layout=lay))
                 else:
-                    packed = _match_global_grouped(
-                        dev, tt, tlen, tdollar, *grouped, budget=g, layout=lay
-                    )
+                    packed = (
+                        _pj("match_global_grouped", _match_global_grouped,
+                            dev, tt, tlen, tdollar, *grouped, budget=g,
+                            layout=lay)
+                        if prof else _match_global_grouped(
+                            dev, tt, tlen, tdollar, *grouped, budget=g,
+                            layout=lay))
                 # the handle carries ITS OWN budget: a sticky widening by a
                 # later handle must not mask this one's truncation
                 return ("g", b, chunk_ids, words,
                         (dev, tt, tlen, tdollar, grouped, lay), packed, g, 0,
                         snap)
-            wi, wb, cn = (
-                _compact_words(words, max_words=self.max_words)
-                if words is not None
-                else _match_partitioned(
-                    dev, tt, tlen, tdollar, chunk_ids,
-                    max_words=self.max_words, layout=lay
-                )
-            )
+            if words is not None:
+                wi, wb, cn = (
+                    _pj("compact_words", _compact_words, words,
+                        max_words=self.max_words)
+                    if prof else _compact_words(words, max_words=self.max_words))
+            else:
+                wi, wb, cn = (
+                    _pj("match_partitioned", _match_partitioned, dev, tt,
+                        tlen, tdollar, chunk_ids, max_words=self.max_words,
+                        layout=lay)
+                    if prof else _match_partitioned(
+                        dev, tt, tlen, tdollar, chunk_ids,
+                        max_words=self.max_words, layout=lay))
             # same contract: the handle carries ITS OWN max_words
             return ("k", b, chunk_ids, words, (dev, tt, tlen, tdollar, lay),
                     wi, wb, cn, self.max_words, snap)
@@ -2330,14 +2452,25 @@ class PartitionedMatcher:
         use_pallas = (bool(self._pallas)
                       and chunk_ids.shape[0] % _pallas_bt() == 0)
         grouped = self._group_inputs(groups, chunk_ids) if groups is not None else None
+        prof = _DEVPROF.enabled
         if grouped is None:
-            packed = _match_fused(
-                dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g, layout=lay,
-                use_pallas=use_pallas, interpret=self._pallas_interpret)
+            packed = (
+                _pj("match_fused", _match_fused, dev, fdev, tt, tlen, tdollar,
+                    chunk_ids, budget=g, layout=lay, use_pallas=use_pallas,
+                    interpret=self._pallas_interpret)
+                if prof else _match_fused(
+                    dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g,
+                    layout=lay, use_pallas=use_pallas,
+                    interpret=self._pallas_interpret))
         else:
-            packed = _match_fused_grouped(
-                dev, fdev, tt, tlen, tdollar, *grouped, budget=g, layout=lay,
-                use_pallas=use_pallas, interpret=self._pallas_interpret)
+            packed = (
+                _pj("match_fused_grouped", _match_fused_grouped, dev, fdev,
+                    tt, tlen, tdollar, *grouped, budget=g, layout=lay,
+                    use_pallas=use_pallas, interpret=self._pallas_interpret)
+                if prof else _match_fused_grouped(
+                    dev, fdev, tt, tlen, tdollar, *grouped, budget=g,
+                    layout=lay, use_pallas=use_pallas,
+                    interpret=self._pallas_interpret))
         return ("f", b, padded,
                 (dev, fdev, tt, tlen, tdollar, chunk_ids, grouped, lay,
                  use_pallas), packed, g)
@@ -2353,8 +2486,18 @@ class PartitionedMatcher:
         lay = self._dev_playout
         log = _LOG
         try:
-            packed = _match_fused(dev, fdev, tt, tlen, tdollar, chunk_ids,
-                                  budget=g, layout=lay)
+            # the static kwargs are spelled exactly like the production
+            # dispatch (_submit_fused): jit caches on static-arg VALUES, so
+            # a kwarg-less verify call would compile a second executable —
+            # and the profiler's shape key must match jax's cache key
+            packed = (
+                _pj("match_fused", _match_fused, dev, fdev, tt, tlen,
+                    tdollar, chunk_ids, budget=g, layout=lay,
+                    use_pallas=False, interpret=self._pallas_interpret)
+                if _DEVPROF.enabled else
+                _match_fused(dev, fdev, tt, tlen, tdollar, chunk_ids,
+                             budget=g, layout=lay, use_pallas=False,
+                             interpret=self._pallas_interpret))
             got = self._complete_fused(
                 ("f", b, chunk_ids.shape[0],
                  (dev, fdev, tt, tlen, tdollar, chunk_ids, None, lay, False),
@@ -2363,8 +2506,12 @@ class PartitionedMatcher:
             log.warning("fused pipeline unavailable (%s); using the "
                         "words+host-decode path", e)
             return False, None
-        ref_packed = _match_global(dev, tt, tlen, tdollar, chunk_ids,
-                                   budget=g, layout=lay)
+        ref_packed = (
+            _pj("match_global", _match_global, dev, tt, tlen, tdollar,
+                chunk_ids, budget=g, layout=lay)
+            if _DEVPROF.enabled else
+            _match_global(dev, tt, tlen, tdollar, chunk_ids, budget=g,
+                          layout=lay))
         want = self._complete_global(
             ("g", b, chunk_ids, None, (dev, tt, tlen, tdollar, None, lay),
              ref_packed, g, fid_base, snap))
@@ -2381,6 +2528,9 @@ class PartitionedMatcher:
         if not agree:
             log.warning("fused pipeline disagrees with the lax+host-decode "
                         "reference; disabled")
+            # postmortem artifact: exactly the class of silent device-path
+            # wrongness the flight recorder exists to capture
+            _DEVPROF.auto_dump("fused_verify_disagreement")
             self.fused_batches -= 1  # the verify run doesn't count as served
             return False, want
         log.info("fused match→compact→decode pipeline verified; enabled")
@@ -2415,8 +2565,12 @@ class PartitionedMatcher:
             parts.append((pt, pl, pd, pc))
             meta.append((s, pb, tier))
             budgets.append(gb)
-        packed = _match_fused_split(dev, fdev, tuple(parts), tuple(budgets),
-                                    layout=lay)
+        packed = (
+            _pj("match_fused_split", _match_fused_split, dev, fdev,
+                tuple(parts), tuple(budgets), layout=lay)
+            if _DEVPROF.enabled else
+            _match_fused_split(dev, fdev, tuple(parts), tuple(budgets),
+                               layout=lay))
         return ("fs", b, order, meta, parts, (dev, fdev, lay), packed,
                 tuple(budgets))
 
@@ -2437,16 +2591,27 @@ class PartitionedMatcher:
             g = 1 << max(8, (n - 1).bit_length())
             key = (chunk_ids.shape[0], chunk_ids.shape[1])
             self._budgets[key] = max(self._budgets.get(key, 0), g)
+            prof = _DEVPROF.enabled
             if grouped is None:
-                packed = _match_fused(
-                    dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g,
-                    layout=lay, use_pallas=use_pallas,
-                    interpret=self._pallas_interpret)
+                packed = (
+                    _pj("match_fused", _match_fused, dev, fdev, tt, tlen,
+                        tdollar, chunk_ids, budget=g, layout=lay,
+                        use_pallas=use_pallas,
+                        interpret=self._pallas_interpret)
+                    if prof else _match_fused(
+                        dev, fdev, tt, tlen, tdollar, chunk_ids, budget=g,
+                        layout=lay, use_pallas=use_pallas,
+                        interpret=self._pallas_interpret))
             else:
-                packed = _match_fused_grouped(
-                    dev, fdev, tt, tlen, tdollar, *grouped, budget=g,
-                    layout=lay, use_pallas=use_pallas,
-                    interpret=self._pallas_interpret)
+                packed = (
+                    _pj("match_fused_grouped", _match_fused_grouped, dev,
+                        fdev, tt, tlen, tdollar, *grouped, budget=g,
+                        layout=lay, use_pallas=use_pallas,
+                        interpret=self._pallas_interpret)
+                    if prof else _match_fused_grouped(
+                        dev, fdev, tt, tlen, tdollar, *grouped, budget=g,
+                        layout=lay, use_pallas=use_pallas,
+                        interpret=self._pallas_interpret))
         if t0:
             now = time.perf_counter_ns()
             self.stage_ns["fetch"] += now - t0
@@ -2497,8 +2662,12 @@ class PartitionedMatcher:
             if ok:
                 break
             budgets = tuple(regrow)
-            packed = _match_fused_split(dev, fdev, tuple(parts), budgets,
-                                        layout=lay)
+            packed = (
+                _pj("match_fused_split", _match_fused_split, dev, fdev,
+                    tuple(parts), budgets, layout=lay)
+                if _DEVPROF.enabled else
+                _match_fused_split(dev, fdev, tuple(parts), budgets,
+                                   layout=lay))
         if t0:
             now = time.perf_counter_ns()
             self.stage_ns["fetch"] += now - t0
@@ -2530,10 +2699,55 @@ class PartitionedMatcher:
         try:
             for s in sizes:
                 self.match(["\x00prewarm/nomatch"] * s)
+            old = self._pad_floor
             self._pad_floor = max(self._pad_floor, sizes[-1])
+            if _DEVPROF.enabled:
+                # pad-waste visibility (floor changes included): the cfg1
+                # small-batch regime must SHOW why it pays what it pays
+                _DEVPROF.note_pad_floor(self._pad_floor, old)
+            elif self._pad_floor != old:
+                _LOG.info("sticky pad floor %d -> %d (small batches pad up "
+                          "to this compiled shape)", old, self._pad_floor)
         except Exception as e:  # pragma: no cover - defensive
             _LOG.warning("matcher prewarm failed (%s); first small "
                          "publishes will pay the compile", e)
+
+    def hbm_breakdown(self) -> dict:
+        """Live HBM occupancy model of this matcher's device residency:
+        automaton tiles (packed or legacy), the fused pipeline's row→fid
+        map, per-segment arrays — plus the host-side overlay journal depth
+        and what legacy field-major tiles would cost at the same padded
+        capacity (the packed-vs-legacy delta the roofline models). The
+        profiler reconciles the modeled total against ``jax.live_arrays()``
+        (broker/devprof.py ``hbm_snapshot``)."""
+
+        def nb(a) -> int:
+            try:
+                return int(a.nbytes) if a is not None else 0
+            except Exception:  # pragma: no cover - exotic array types
+                return 0
+
+        tiles = fid = segs = 0
+        if self._segments is not None:
+            segs = len(self._segments)
+            for _base, _end, dev, fdev in self._segments:
+                tiles += nb(dev)
+                fid += nb(fdev)
+        else:
+            tiles = nb(self._dev_arrays)
+            fid = nb(self._dev_fids)
+        t = self.table
+        up = self._dev_up_chunks or _pad_chunk_count(t.nchunks)
+        legacy = up * CHUNK * (t.max_levels + 3) * (4 if t._tok_wide else 2)
+        return {
+            "layout": "packed" if self._dev_playout is not None else "legacy",
+            "tiles_bytes": tiles,
+            "fid_map_bytes": fid,
+            "segments": segs,
+            "legacy_tiles_bytes_model": int(legacy),
+            "overlay_journal_entries": len(t._fid_undo_v),
+            "total_bytes": tiles + fid,
+        }
 
     def _submit_segmented(self, ttok, tlen, tdollar, chunk_ids, b: int, snap):
         """One sub-handle per table segment: global candidate chunk ids are
@@ -2605,7 +2819,9 @@ class PartitionedMatcher:
         _tag, b, handles = handle
         fused_before = self.fused_batches
         per_seg = [
-            [self._EMPTY_FIDS] * b if h[0] == "E" else self.match_complete(h)
+            # sub-handles complete through the impl directly: only the
+            # top-level "M" handle owns a profiler flight record
+            [self._EMPTY_FIDS] * b if h[0] == "E" else self._complete_impl(h)
             for h in handles
         ]
         if self.fused_batches > fused_before:
@@ -2653,8 +2869,11 @@ class PartitionedMatcher:
             meta.append((s, pb, tier))
             budgets.append(g)
         lay = self._dev_playout
-        packed = _match_global_split(dev, tuple(parts), tuple(budgets),
-                                     layout=lay)
+        packed = (
+            _pj("match_global_split", _match_global_split, dev, tuple(parts),
+                tuple(budgets), layout=lay)
+            if _DEVPROF.enabled else
+            _match_global_split(dev, tuple(parts), tuple(budgets), layout=lay))
         return ("s", b, order, meta, parts, (dev, lay), packed, tuple(budgets),
                 fid_base, snap)
 
@@ -2683,8 +2902,11 @@ class PartitionedMatcher:
             if ok:
                 break
             budgets = tuple(regrow)
-            packed = _match_global_split(dev, tuple(parts), budgets,
-                                         layout=lay)
+            packed = (
+                _pj("match_global_split", _match_global_split, dev,
+                    tuple(parts), budgets, layout=lay)
+                if _DEVPROF.enabled else
+                _match_global_split(dev, tuple(parts), budgets, layout=lay))
         # the decode snapshot is taken AFTER the blocking fetch (like every
         # other complete path); _decode_revalidated closes the
         # overlay→gather write window without stalling mutations
@@ -2751,6 +2973,46 @@ class PartitionedMatcher:
 
     def match_complete(self, handle) -> List[np.ndarray]:
         """Block on a ``match_submit`` handle and decode to fid arrays."""
+        if not _DEVPROF.enabled:
+            if self._prof_pending:
+                # entries from a just-disabled profiler must still be
+                # dropped: a pending record holds the handle (device
+                # buffers included) and would pin it until 16 future
+                # ENABLED submits flush it with bogus timing
+                self._prof_drop(handle)
+            return self._complete_impl(handle)
+        ent = self._prof_drop(handle)
+        if ent is None:
+            # a handle submitted before the profiler flipped on (or an
+            # internal sub-handle): complete without a flight record
+            return self._complete_impl(handle)
+        _h, rec, sn0 = ent
+        fused0 = self.fused_batches
+        t0 = time.perf_counter_ns()
+        out = self._complete_impl(handle)
+        rec["complete_ns"] = time.perf_counter_ns() - t0
+        rec["fused"] = self.fused_batches > fused0
+        rec["routes"] = int(sum(len(r) for r in out))
+        if sn0 is not None:
+            # per-stage ns deltas (PR9 stage_timing). Pipelined overlap can
+            # smear attribution between ADJACENT records (stage counters
+            # are matcher-cumulative); totals stay exact
+            rec["stage_ns"] = {k: self.stage_ns[k] - sn0[k]
+                               for k in self.stage_ns}
+        _DEVPROF.note_dispatch(rec, rec["submit_ns"] + rec["complete_ns"])
+        return out
+
+    def _prof_drop(self, handle):
+        """Pop (by handle IDENTITY) this handle's pending flight record,
+        if any — sub-handles and pre-profiler handles return None."""
+        with self._prof_lock:
+            for i, cand in enumerate(self._prof_pending):
+                if cand[0] is handle:
+                    del self._prof_pending[i]
+                    return cand
+        return None
+
+    def _complete_impl(self, handle) -> List[np.ndarray]:
         if handle[0] == "M":
             return self._complete_segmented(handle)
         if handle[0] == "r":
@@ -2771,14 +3033,19 @@ class PartitionedMatcher:
             # rare: re-run wider; sticky so later batches skip the narrow run
             kw = 1 << (int(cn[:b].max()) - 1).bit_length()
             self.max_words = max(self.max_words, kw)
+            prof = _DEVPROF.enabled
             if words is not None:
-                wi, wb, cn = _compact_words(words, max_words=kw)
+                wi, wb, cn = (
+                    _pj("compact_words", _compact_words, words, max_words=kw)
+                    if prof else _compact_words(words, max_words=kw))
             else:
                 dev, ttok, tlen, tdollar, lay = dev_inputs
-                wi, wb, cn = _match_partitioned(
-                    dev, ttok, tlen, tdollar, chunk_ids, max_words=kw,
-                    layout=lay
-                )
+                wi, wb, cn = (
+                    _pj("match_partitioned", _match_partitioned, dev, ttok,
+                        tlen, tdollar, chunk_ids, max_words=kw, layout=lay)
+                    if prof else _match_partitioned(
+                        dev, ttok, tlen, tdollar, chunk_ids, max_words=kw,
+                        layout=lay))
         return self._decode_revalidated(
             snap, 0,
             lambda fid_map, overlay, strict: _decode_batch(
@@ -2822,20 +3089,28 @@ class PartitionedMatcher:
             g = 1 << max(8, (n - 1).bit_length())
             # sticky pow2 regrow for this batch shape
             self._budgets[(padded, nc)] = max(self._budgets.get((padded, nc), 0), g)
+            prof = _DEVPROF.enabled
             if words is not None:
-                packed = _compact_global(words, budget=g)
+                packed = (_pj("compact_global", _compact_global, words,
+                              budget=g)
+                          if prof else _compact_global(words, budget=g))
             else:
                 dev, ttok, tlen, tdollar, grouped, lay = dev_inputs
                 if grouped is None:
-                    packed = _match_global(
-                        dev, ttok, tlen, tdollar, chunk_ids, budget=g,
-                        layout=lay
-                    )
+                    packed = (
+                        _pj("match_global", _match_global, dev, ttok, tlen,
+                            tdollar, chunk_ids, budget=g, layout=lay)
+                        if prof else _match_global(
+                            dev, ttok, tlen, tdollar, chunk_ids, budget=g,
+                            layout=lay))
                 else:
-                    packed = _match_global_grouped(
-                        dev, ttok, tlen, tdollar, *grouped, budget=g,
-                        layout=lay
-                    )
+                    packed = (
+                        _pj("match_global_grouped", _match_global_grouped,
+                            dev, ttok, tlen, tdollar, *grouped, budget=g,
+                            layout=lay)
+                        if prof else _match_global_grouped(
+                            dev, ttok, tlen, tdollar, *grouped, budget=g,
+                            layout=lay))
         if t0:
             now = time.perf_counter_ns()
             self.stage_ns["fetch"] += now - t0

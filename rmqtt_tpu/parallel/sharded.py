@@ -28,9 +28,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from rmqtt_tpu.broker.devprof import DEVPROF as _DEVPROF
 from rmqtt_tpu.ops.encode import FilterTable
 from rmqtt_tpu.ops.match import DEFAULT_CHUNK, match_packed_impl
-from rmqtt_tpu.ops.partitioned import _FP_UPLOAD
+from rmqtt_tpu.ops.partitioned import _FP_UPLOAD, _pj
 from rmqtt_tpu.utils.devfetch import fetch
 
 # shard_map moved homes across jax releases: stable `jax.shard_map` (new)
@@ -274,17 +275,30 @@ class ShardedPartitionedMatcher:
                     idx, vals = _pad_scatter_pow2(
                         np.asarray(cids, dtype=np.int32), tiles
                     )
-                    self._dev_rows = self._dev_rows.at[idx].set(vals)
+                    self._dev_rows = (
+                        _pj("sharded_delta_scatter",
+                            lambda a, i, v: a.at[i].set(v),
+                            self._dev_rows, idx, vals)
+                        if _DEVPROF.enabled else
+                        self._dev_rows.at[idx].set(vals))
                     self.uploads += 1
                     self.delta_uploads += 1
-                    self.upload_bytes += tiles.nbytes
+                    nb = tiles.nbytes
                     if want_fids and self._dev_fids is not None:
                         ftiles = pack_fid_chunk_tiles(t, cids)
                         fidx, fvals = _pad_scatter_pow2(
                             np.asarray(cids, dtype=np.int32), ftiles
                         )
-                        self._dev_fids = self._dev_fids.at[fidx].set(fvals)
-                        self.upload_bytes += ftiles.nbytes
+                        self._dev_fids = (
+                            _pj("sharded_delta_scatter_fids",
+                                lambda a, i, v: a.at[i].set(v),
+                                self._dev_fids, fidx, fvals)
+                            if _DEVPROF.enabled else
+                            self._dev_fids.at[fidx].set(fvals))
+                        nb += ftiles.nbytes
+                    self.upload_bytes += nb
+                    if _DEVPROF.enabled:
+                        _DEVPROF.note_upload("delta", nb)
                 self._dev_version = t.version
                 self._dev_fid_map = t._fid_of_row
                 return self._dev_rows
@@ -311,9 +325,34 @@ class ShardedPartitionedMatcher:
         self._dev_fid_map = fid_map
         self.uploads += 1
         self.full_uploads += 1
-        self.upload_bytes += packed.nbytes + (
-            fids2d.nbytes if fids2d is not None else 0)
+        nb = packed.nbytes + (fids2d.nbytes if fids2d is not None else 0)
+        self.upload_bytes += nb
+        if _DEVPROF.enabled:
+            _DEVPROF.note_upload("full", nb)
         return self._dev_rows
+
+    def hbm_breakdown(self) -> dict:
+        """HBM occupancy model of the replicated device table: logical
+        bytes × replica count (the table is replicated over every mesh
+        device), mirroring ``PartitionedMatcher.hbm_breakdown``."""
+
+        def nb(a) -> int:
+            try:
+                return int(a.nbytes) if a is not None else 0
+            except Exception:  # pragma: no cover
+                return 0
+
+        tiles, fid = nb(self._dev_rows), nb(self._dev_fids)
+        return {
+            "layout": "legacy",
+            "tiles_bytes": tiles,
+            "fid_map_bytes": fid,
+            "segments": 0,
+            "replicas": self.ndev,
+            "overlay_journal_entries": len(
+                getattr(self.table, "_fid_undo_v", ())),
+            "total_bytes": (tiles + fid) * self.ndev,
+        }
 
     def match(self, topics) -> list:
         from rmqtt_tpu.ops.partitioned import _decode_batch, _match_partitioned
@@ -423,6 +462,7 @@ class ShardedPartitionedMatcher:
                     if not agree:
                         log.warning("sharded fused pipeline disagrees with "
                                     "the host-decode reference; disabled")
+                        _DEVPROF.auto_dump("fused_verify_disagreement")
                         return want
                     log.info("sharded fused pipeline verified; enabled")
                 self.fused_batches += 1
@@ -438,8 +478,16 @@ class ShardedPartitionedMatcher:
             self._budgets[padded] = gd
         bl = padded // self.ndev
         while True:
-            arr = fetch(self._fused_step(gd)(dev, self._dev_fids, *inputs),
-                        "sharded fused fetch")
+            # the budget is baked into the step CLOSURE (one jitted step per
+            # gd), so it must ride the profiler key explicitly — arg shapes
+            # alone are identical across budget regrows, and a regrow IS a
+            # recompile the storm detector must see
+            step = self._fused_step(gd)
+            out_dev = (
+                _pj("sharded_fused", step, dev, self._dev_fids, *inputs,
+                    _key_extra=("budget", gd))
+                if _DEVPROF.enabled else step(dev, self._dev_fids, *inputs))
+            arr = fetch(out_dev, "sharded fused fetch")
             per_dev = arr.reshape(self.ndev, gd + bl)
             cn = per_dev[:, gd:].astype(np.int64)
             totals = cn.sum(axis=1)
@@ -471,7 +519,14 @@ class ShardedPartitionedMatcher:
         bl = padded // self.ndev  # topics per device
         while True:
             # one fetch: per-device [routes(gd)... | cnts(bl)...], concatenated
-            arr = fetch(self._global_step(gd)(dev, *inputs), "sharded match fetch")
+            # (gd rides the profiler key explicitly: the budget is baked
+            # into the step closure, so arg shapes alone would classify a
+            # budget-regrow recompile as a cache hit)
+            step = self._global_step(gd)
+            out_dev = (_pj("sharded_global", step, dev, *inputs,
+                           _key_extra=("budget", gd))
+                       if _DEVPROF.enabled else step(dev, *inputs))
+            arr = fetch(out_dev, "sharded match fetch")
             per_dev = arr.reshape(self.ndev, gd + bl)
             cn = per_dev[:, gd:].astype(np.int64)  # [ndev, bl], shard-major
             totals = cn.sum(axis=1)

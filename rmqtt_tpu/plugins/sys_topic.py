@@ -118,6 +118,7 @@ class SysTopicPlugin(Plugin):
                 )
                 await self._publish_latency()
                 await self._publish_tracing()
+                await self._publish_device()
             await self._publish_slo()
             await self._publish_overload()
             await self._publish_failover()
@@ -147,6 +148,32 @@ class SysTopicPlugin(Plugin):
                 f"{self._prefix}/latency/slow_ops",
                 json.dumps(snap["slow_ops"]).encode(),
             )
+
+    async def _publish_device(self) -> None:
+        """$SYS/brokers/<node>/device/#: the device-plane profiler's
+        compile registry under ``device/compile`` (traces, cache hits,
+        retrace storms), the HBM occupancy model under ``device/hbm`` and
+        the latest dispatch rollup under ``device/dispatch``
+        (broker/devprof.py). Published only while the profiler is enabled
+        — trie-only / profiler-off brokers keep their $SYS tree unchanged."""
+        from rmqtt_tpu.broker.devprof import DEVPROF
+
+        if not DEVPROF.enabled:
+            return
+        snap = DEVPROF.snapshot()
+        compile_row = dict(snap["compile"])
+        compile_row.pop("kernels", None)  # per-key detail stays on the API
+        await self._publish(
+            f"{self._prefix}/device/compile", json.dumps(compile_row).encode()
+        )
+        await self._publish(
+            f"{self._prefix}/device/hbm", json.dumps(snap["hbm"]).encode()
+        )
+        disp = dict(snap["dispatch"])
+        disp["rollups"] = disp.get("rollups", [])[-6:]  # bounded payload
+        await self._publish(
+            f"{self._prefix}/device/dispatch", json.dumps(disp).encode()
+        )
 
     async def _publish_slo(self) -> None:
         """$SYS/brokers/<node>/slo/#: ``slo/state`` carries the worst

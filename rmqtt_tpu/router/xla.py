@@ -364,6 +364,10 @@ class XlaRouter(Router):
         shipped, background compactions and their cost, selective
         candidate-cache invalidations)."""
         m, t = self.matcher, self.table
+        # per-stage wall attribution (PR9 stage_timing, promoted from
+        # bench-only to the live stats surface): cumulative ns → ms totals,
+        # zeros while stage_timing is off (the dict exists either way)
+        sn = getattr(m, "stage_ns", None) or {}
         return {
             "uploads": getattr(m, "uploads", 0),
             "delta_uploads": getattr(m, "delta_uploads", 0),
@@ -375,7 +379,18 @@ class XlaRouter(Router):
             # (ops/partitioned.py): nonzero proves host decode is off the
             # per-batch path
             "fused_batches": getattr(m, "fused_batches", 0),
+            "stage_encode_ms_total": round(sn.get("encode", 0) / 1e6, 3),
+            "stage_dispatch_ms_total": round(sn.get("dispatch", 0) / 1e6, 3),
+            "stage_fetch_ms_total": round(sn.get("fetch", 0) / 1e6, 3),
+            "stage_decode_ms_total": round(sn.get("decode", 0) / 1e6, 3),
         }
+
+    def device_hbm(self) -> Dict[str, float]:
+        """HBM occupancy model of the device table mirror (tiles, fid map,
+        segments) — the device profiler's provider seam
+        (broker/devprof.py); {} for matchers without a breakdown."""
+        f = getattr(self.matcher, "hbm_breakdown", None)
+        return f() if callable(f) else {}
 
     def is_match(self, topic: str) -> bool:
         if self._side is not None:

@@ -51,10 +51,13 @@ MAX_HOURS = 11.5
 
 # per-config subprocess deadlines (seconds). cfg4/cfg5 build 10M-filter
 # tables (minutes of host work) before the first device touch; cfg11 is
-# the small-batch paired estimator (tiny table, many micro dispatches).
-CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200, 11: 1800}
-CONFIG_ORDER = (1, 2, 3, 11, 4, 5)  # cheap + diagnostic before the 10M builds
+# the small-batch paired estimator (tiny table, many micro dispatches);
+# cfg12 bounds the device-profiler overhead on chip.
+CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200, 11: 1800,
+                  12: 1800}
+CONFIG_ORDER = (1, 2, 3, 11, 12, 4, 5)  # cheap + diagnostic before 10M builds
 SMOKE_TIMEOUT = 1200
+DEVPROF_DIR = REPO / ".devprof"
 
 
 def log(msg: str) -> None:
@@ -81,7 +84,14 @@ def run_sub(cmd: list[str], timeout: float,
             env: dict | None = None) -> tuple[int, str, str]:
     """Run a child in its own process group so a wedged device fetch can be
     killed together with any grandchildren it spawned. ``env`` entries
-    overlay the inherited environment (the fused-vs-unfused A/B runs)."""
+    overlay the inherited environment (the fused-vs-unfused A/B runs).
+
+    A timed-out child gets SIGTERM first — bench.py's handler raises
+    KeyboardInterrupt, whose guarded() path freezes the device flight
+    recorder into ``.devprof/<cfg-name>.json`` on the way out (the
+    postmortem a wedged cfg4/cfg5 window needs; ``collect_devprof_dump``
+    checkpoints it) — then SIGKILL if it doesn't exit within the grace
+    period."""
     try:
         child_env = None
         if env:
@@ -95,6 +105,12 @@ def run_sub(cmd: list[str], timeout: float,
         out, err = p.communicate(timeout=timeout)
         return p.returncode, out, err
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+            out, err = p.communicate(timeout=15)
+            return -15, out or "", (err or "") + f"\n[hunter] TERMed after {timeout}s"
+        except Exception:
+            pass
         try:
             os.killpg(p.pid, signal.SIGKILL)
         except Exception:
@@ -157,6 +173,32 @@ def merge_snapshot(st: dict) -> None:
     log(f"merged snapshot → BENCH_LAST_TPU.json ({sorted(configs)})")
 
 
+def collect_devprof_dump(n: int, since: float) -> str | None:
+    """Pull the failed config's device flight-recorder dump (written by
+    bench.py's guarded()/interrupt handler) into the hunt dir, so the
+    artifact survives `.devprof` housekeeping between windows. ``since``
+    (the config's start time) gates recency — `.devprof` persists across
+    windows, and checkpointing a STALE dump as this failure's postmortem
+    would send the operator to the wrong run. → the checkpointed path, or
+    None when the child died dump-less (SIGKILL after an unanswered TERM)."""
+    try:
+        cands = sorted(
+            [p for p in DEVPROF_DIR.glob(f"cfg{n}_*.json")
+             if p.stat().st_mtime >= since - 5],
+            key=lambda p: p.stat().st_mtime, reverse=True,
+        )
+        if not cands:
+            return None
+        dst = HUNT_DIR / f"devprof_cfg{n}.json"
+        dst.write_text(cands[0].read_text())
+        log(f"cfg{n} flight-recorder dump checkpointed -> {dst.name} "
+            f"(from {cands[0].name})")
+        return str(dst)
+    except Exception as e:
+        log(f"cfg{n} devprof dump collection failed: {e}")
+        return None
+
+
 def probe() -> int:
     from rmqtt_tpu.utils.tpuprobe import probe_device_count
 
@@ -214,6 +256,10 @@ def chip_window(st: dict) -> None:
                                 "err": " | ".join(err_tail)[-500:]}
         save_state(st)
         log(f"cfg{n} FAILED rc={rc} after {took}s: {' | '.join(err_tail)[:300]}")
+        dump = collect_devprof_dump(n, since=t0)
+        if dump:
+            st["failed"][str(n)]["devprof_dump"] = dump
+            save_state(st)
         # a failure may mean the grant wedged: re-probe before burning the
         # next config's table build on a dead chip
         if probe() == 0:
