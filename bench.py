@@ -1611,6 +1611,261 @@ def run_failover_config(name, rng, reduced):
     return res
 
 
+def run_fabric_config(name, rng, reduced):
+    """Config 13: intra-node routing fabric vs localhost-broadcast workers,
+    cfg7-style order-symmetric paired estimator.
+
+    Two live 4-worker topologies in one process (each worker a full broker
+    with its own listener — deterministic client placement, unlike
+    SO_REUSEPORT kernel balancing): the FABRIC leg wires them through
+    broker/fabric.py over real UDS sockets; the BROADCAST leg peers them
+    as the localhost broadcast cluster `--workers` used before (real TCP
+    cluster RPC). The workload is the shape ROADMAP item 2 calls out —
+    cross-worker fan-out with a *placed* subscriber fleet: ``npubs``
+    concurrent publishers on worker 2, the subscriber fleet on worker 4,
+    QoS0 at 512-byte payloads.
+    This is exactly where the architectures diverge: broadcast mode has no
+    idea where subscribers live, so EVERY publish pays full cluster-RPC
+    serialization against EVERY peer and a scatter-gather match on all of
+    them; the fabric matches once at the owner and writes one deliver
+    frame to the one worker that owns the fleet. Bursts alternate legs in
+    order-symmetric quads; the ratio of per-burst goodputs is the
+    artifact's ``fanout_goodput_ratio`` (target ≥ 3× at 4 workers on CPU).
+    The CONNECT-takeover probe reconnects a client id across workers and
+    reports per-leg kick p99 — the fabric resolves it via the directory
+    (one targeted RPC), broadcast scatters a kick RPC to every peer."""
+    import asyncio
+    import tempfile
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.fitter import FitterConfig
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    nworkers = 4
+    nsubs = 2  # the placed fleet on worker 4
+    npubs = 32  # concurrent publisher sessions on worker 2
+    per = 512 if reduced else 1024  # publishes per burst (×nsubs deliveries)
+    quads = 3 if reduced else 5
+    kick_iters = 12 if reduced else 30
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _leg_fabric():
+        td = tempfile.mkdtemp(prefix="cfg13-fab-")
+        workers = []
+        for wid in range(1, nworkers + 1):
+            b = MqttBroker(ServerContext(BrokerConfig(
+                port=0, node_id=wid, telemetry_enable=False,
+                fitter=FitterConfig(max_mqueue=100_000),
+                fabric_enable=True, fabric_dir=td, fabric_worker_id=wid,
+                fabric_workers=nworkers)))
+            await b.start()
+            workers.append(b)
+        deadline = time.monotonic() + 10
+        while not all(w.ctx.fabric.is_owner or w.ctx.fabric._owner_up.is_set()
+                      for w in workers):
+            assert time.monotonic() < deadline, "fabric never registered"
+            await asyncio.sleep(0.05)
+        return workers, None
+
+    async def _leg_broadcast():
+        from rmqtt_tpu.cluster.broadcast import BroadcastCluster
+        from rmqtt_tpu.cluster.transport import PeerClient
+
+        workers, clusters = [], []
+        for wid in range(1, nworkers + 1):
+            b = MqttBroker(ServerContext(BrokerConfig(
+                port=0, node_id=wid, telemetry_enable=False, cluster=True,
+                fitter=FitterConfig(max_mqueue=100_000))))
+            await b.start()
+            workers.append(b)
+        for b in workers:
+            c = BroadcastCluster(b.ctx, ("127.0.0.1", 0), [])
+            await c.start()
+            clusters.append(c)
+        for i, c in enumerate(clusters):
+            for j, other in enumerate(clusters):
+                if i != j:
+                    nid = workers[j].ctx.node_id
+                    c.peers[nid] = PeerClient(nid, "127.0.0.1",
+                                              other.bound_port)
+            c.bcast.peers = list(c.peers.values())
+        return workers, clusters
+
+    async def _wire_traffic(workers, tag):
+        """The placed fleet: nsubs subscribers on worker 4 + npubs
+        publishers on worker 2; → (burst fn, close fn)."""
+        subs = []
+        for k in range(nsubs):
+            r, w, c = await _connect(workers[3].port, f"{tag}s{k}")
+            w.write(c.encode(pk.Subscribe(
+                1, [("fab/#", pk.SubOpts(qos=0))])))
+            await w.drain()
+            await _read_until(r, c, pk.Suback)
+            subs.append((r, w, c))
+        pubs = [await _connect(workers[1].port, f"{tag}p{k}")
+                for k in range(npubs)]
+        frames = [pubs[0][2].encode(pk.Publish(
+            topic=f"fab/t{i}", payload=b"x" * 512, qos=0))
+            for i in range(32)]
+        await asyncio.sleep(0.3)  # subscription replication settles
+
+        async def burst(n):
+            """n publishes spread across the npubs publisher sessions;
+            → (active-window seconds, deliveries across the fleet)."""
+            got = [0] * len(subs)
+            done = asyncio.Event()
+            want_total = n * len(subs)
+            total = [0]
+            last = [0.0]  # timestamp of the latest delivery (effective end)
+
+            async def drain(si, reader, codec):
+                while total[0] < want_total:
+                    try:
+                        data = await asyncio.wait_for(reader.read(1 << 16), 2.0)
+                    except asyncio.TimeoutError:
+                        return  # QoS0: late stragglers are counted as lost
+                    if not data:
+                        return
+                    k = sum(1 for p in codec.feed(data)
+                            if isinstance(p, pk.Publish))
+                    got[si] += k
+                    total[0] += k
+                    last[0] = time.perf_counter()
+                    if total[0] >= want_total:
+                        done.set()
+
+            t0 = time.perf_counter()
+            drains = [asyncio.get_running_loop().create_task(
+                drain(si, r, c)) for si, (r, _w, c) in enumerate(subs)]
+
+            async def feed(pi, count):
+                _r, w, _c = pubs[pi]
+                sent = 0
+                while sent < count:
+                    k = min(32, count - sent)
+                    w.write(b"".join(frames[(sent + j) % 32]
+                                     for j in range(k)))
+                    sent += k
+                    await w.drain()
+
+            await asyncio.gather(*(feed(pi, n // npubs)
+                                   for pi in range(npubs)))
+            try:
+                await asyncio.wait_for(done.wait(), 30.0)
+            except asyncio.TimeoutError:
+                pass
+            # goodput over the active delivery window: a leg that sheds
+            # (or idles out) is measured to its LAST delivery, not to the
+            # idle-timeout tail
+            elapsed = (last[0] or time.perf_counter()) - t0
+            for t in drains:
+                t.cancel()
+            return max(elapsed, 1e-6), total[0]
+
+        async def close():
+            for r, w, _c in [*subs, *pubs]:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        return burst, close
+
+    async def _kick_p99(workers, tag):
+        """Reconnect one client id across workers; CONNECT wall time of the
+        takeover side (includes the kick resolution) → p99 ms."""
+        times = []
+        for i in range(kick_iters):
+            cid = f"{tag}kick{i}"
+            _r1, w1, _c1 = await _connect(workers[2].port, cid)
+            t0 = time.perf_counter()
+            _r2, w2, _c2 = await _connect(workers[3].port, cid)
+            times.append((time.perf_counter() - t0) * 1e3)
+            for w in (w1, w2):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        return float(np.percentile(times, 99)), float(np.percentile(times, 50))
+
+    async def _measure():
+        fab_workers, _ = await _leg_fabric()
+        bc_workers, bc_clusters = await _leg_broadcast()
+        try:
+            fab_burst, fab_close = await _wire_traffic(fab_workers, "f")
+            bc_burst, bc_close = await _wire_traffic(bc_workers, "b")
+            await fab_burst(128)  # warm both paths (codec, links, caches)
+            await bc_burst(128)
+            pairs = []
+            for _ in range(quads):
+                # order-symmetric quad (fab, bc, bc, fab): taking each
+                # condition's BEST goodput of its two bursts (= fastest
+                # burst) filters one-sided load spikes before the ratio
+                ef1, nf1 = await fab_burst(per)
+                eb1, nb1 = await bc_burst(per)
+                eb2, nb2 = await bc_burst(per)
+                ef2, nf2 = await fab_burst(per)
+                gf = max(nf1 / ef1, nf2 / ef2)
+                gb = max(nb1 / eb1, nb2 / eb2)
+                pairs.append((gf, gb))
+            fk99, fk50 = await _kick_p99(fab_workers, "f")
+            bk99, bk50 = await _kick_p99(bc_workers, "b")
+            await fab_close()
+            await bc_close()
+            return pairs, (fk99, fk50), (bk99, bk50)
+        finally:
+            for c in bc_clusters or []:
+                await c.stop()
+            for b in [*fab_workers, *bc_workers]:
+                await b.stop()
+
+    pairs, fab_kick, bc_kick = asyncio.run(_measure())
+    ratio = float(np.median([gf / gb for gf, gb in pairs]))
+    fab_goodput = max(gf for gf, _ in pairs)
+    bc_goodput = max(gb for _, gb in pairs)
+    res = {
+        "name": name,
+        "workers": nworkers,
+        "subscribers": nsubs,
+        "publishers": npubs,
+        "msgs_per_burst": per,
+        "fanout_goodput_fabric": round(fab_goodput, 1),
+        "fanout_goodput_broadcast": round(bc_goodput, 1),
+        "fanout_goodput_ratio": round(ratio, 2),
+        "target_ratio": 3.0,
+        "ok": ratio >= 3.0,
+        "connect_kick_ms": {
+            "fabric_p50": round(fab_kick[1], 3),
+            "fabric_p99": round(fab_kick[0], 3),
+            "broadcast_p50": round(bc_kick[1], 3),
+            "broadcast_p99": round(bc_kick[0], 3),
+        },
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] cross-worker fan-out: fabric {fab_goodput:.0f} vs "
+        f"broadcast {bc_goodput:.0f} deliveries/s → {ratio:.2f}x "
+        f"(target ≥3x) | CONNECT kick p99 fabric {res['connect_kick_ms']['fabric_p99']}ms "
+        f"vs broadcast {res['connect_kick_ms']['broadcast_p99']}ms")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -1700,13 +1955,15 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 12
+            return i <= 13
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak), cfg9 (churn soak / delta uploads), cfg11
-        # (small-batch stage attribution) and cfg12 (device-profiler
-        # overhead bound) are cheap and always informative
-        return i <= 3 or i in (6, 7, 8, 9, 10, 11, 12) or args.full or on_tpu
+        # (small-batch stage attribution), cfg12 (device-profiler
+        # overhead bound) and cfg13 (fabric-vs-broadcast fan-out) are
+        # cheap and always informative
+        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13)
+                or args.full or on_tpu)
 
     failures = {}
     if args.profile:
@@ -1843,6 +2100,12 @@ def main():
 
         guarded("cfg12_devprof_overhead", cfg12)
 
+    if want(13):
+        def cfg13():
+            return run_fabric_config("cfg13_fabric_paired", rng, reduced)
+
+        guarded("cfg13_fabric_paired", cfg13)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -1853,6 +2116,27 @@ def main():
     failover_res = results.pop("cfg10_failover_soak", None)
     smallbatch_res = results.pop("cfg11_smallbatch_paired", None)
     devprof_res = results.pop("cfg12_devprof_overhead", None)
+    fabric_res = results.pop("cfg13_fabric_paired", None)
+    if (not results and fabric_res is not None and devprof_res is None
+            and smallbatch_res is None and failover_res is None
+            and churn_res is None and overload_res is None
+            and tele_res is None and cache_res is None):
+        # a --config 13 run: its own artifact shape; the ≥3× cross-worker
+        # fan-out bound FAILS the run (exit 1) so CI can gate on it
+        print(json.dumps({
+            "metric": "fanout_goodput_ratio[cfg13_fabric_paired]",
+            "value": fabric_res["fanout_goodput_ratio"],
+            "unit": "x_fabric_over_broadcast",
+            "vs_baseline": fabric_res["fanout_goodput_ratio"],
+            "ok": fabric_res["ok"],
+            "connect_kick_ms": fabric_res["connect_kick_ms"],
+            "platform": platform,
+            "fabric_paired": fabric_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not fabric_res["ok"]:
+            sys.exit(1)
+        return
     # every bench JSON carries the device-plane profiler snapshot + the
     # tail of the flight ring (satellite of the devprof PR: on-chip runs
     # become diagnosable from the artifact alone)
@@ -2069,6 +2353,10 @@ def main():
         # of the [observability] device_profile knob (broker/devprof.py)
         **({"devprof_overhead": devprof_res}
            if devprof_res is not None else {}),
+        # intra-node fabric paired estimator (cfg13): cross-worker fan-out
+        # goodput fabric-vs-broadcast + per-leg CONNECT kick p99
+        # (broker/fabric.py)
+        **({"fabric_paired": fabric_res} if fabric_res is not None else {}),
         **devprof_embed,
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),

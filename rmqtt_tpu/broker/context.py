@@ -100,6 +100,26 @@ class BrokerConfig:
     route_cache_shared_bypass: bool = False
     cluster: bool = False  # use a cluster-aware session registry
     cluster_mode: str = "broadcast"  # "broadcast" | "raft"
+    # intra-node routing fabric (broker/fabric.py, [fabric] config section):
+    # one router owner per node serving every SO_REUSEPORT worker over a
+    # UDS mesh — batched publish submission, zero-copy QoS0 fan-out, and a
+    # node-local subscription directory for O(1) CONNECT kicks. Disabled by
+    # default: `--workers N` without [fabric] peers as a localhost
+    # broadcast cluster exactly as before (zero-behavior-change pin).
+    fabric_enable: bool = False
+    fabric_dir: str = ""  # UDS socket directory (required when enabled)
+    fabric_worker_id: int = 0  # 0 = use node_id
+    fabric_owner_id: int = 1  # worker holding the device table + directory
+    fabric_workers: int = 0  # expected worker count (informational)
+    fabric_batch_max: int = 256  # publishes coalesced per submit frame
+    fabric_call_timeout_s: float = 5.0
+    # owner-outage bound: submits park this long awaiting reconnect +
+    # re-register, then degrade to worker-local match (reason-counted)
+    fabric_submit_deadline_s: float = 20.0
+    # owner warm-up gate: a (re)spawned owner holds submitted fan-outs
+    # until every expected worker has re-registered its table slice, or
+    # this many seconds pass (so one dead worker can't stall the node)
+    fabric_warm_grace_s: float = 10.0
     # overload protection (reference busy detection, node.rs:212-239 +
     # handshake executor limits, executor.rs:66-137). NOTE reference
     # semantics: new connections are REFUSED once a listener's active
@@ -290,7 +310,27 @@ class ServerContext:
         # plugin installs itself here; None = storage disabled (the
         # reference's DefaultMessageManager no-op, message.rs:148-164)
         self.message_mgr = None
-        if self.cfg.cluster and self.cfg.cluster_mode == "raft":
+        # intra-node routing fabric (broker/fabric.py): one router owner per
+        # node, workers submit publishes over a UDS mesh. Mutually exclusive
+        # with the cluster registries — the fabric IS this node's internal
+        # cluster; federating fabric nodes is ROADMAP item 3 territory.
+        self.fabric = None
+        if self.cfg.fabric_enable:
+            if self.cfg.cluster:
+                raise ValueError(
+                    "[fabric] and [cluster] cannot combine in one process: "
+                    "the fabric replaces the intra-node cluster peering")
+            if not self.cfg.fabric_dir:
+                raise ValueError("[fabric] enable=true requires fabric.dir")
+            from rmqtt_tpu.broker.fabric import (
+                FabricService,
+                FabricSessionRegistry,
+            )
+
+            self.fabric = FabricService(self, self.cfg)
+            self.routing.fabric = self.fabric
+            self.registry = FabricSessionRegistry(self)
+        elif self.cfg.cluster and self.cfg.cluster_mode == "raft":
             from rmqtt_tpu.cluster.raft_mode import RaftSessionRegistry
 
             self.registry = RaftSessionRegistry(self)
@@ -417,6 +457,8 @@ class ServerContext:
         self.slo.start()
 
     async def stop(self) -> None:
+        if self.fabric is not None:
+            await self.fabric.stop()
         await self.slo.stop()
         await self.overload.stop()
         await self.routing.stop()

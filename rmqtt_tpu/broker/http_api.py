@@ -233,7 +233,7 @@ class HttpApi:
                 "/api/v1/latency", "/api/v1/latency/sum",
                 "/api/v1/slo", "/api/v1/slo/sum",
                 "/api/v1/device", "/api/v1/device/sum",
-                "/api/v1/overload",
+                "/api/v1/overload", "/api/v1/fabric",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
                 "/api/v1/traces", "/api/v1/traces/slow",
                 "/api/v1/traces/{trace_id}",
@@ -473,6 +473,14 @@ class HttpApi:
                             {str(k): str(v) for k, v in req.items()})
             return 200, {"node": ctx.node_id,
                          "failpoints": FAILPOINTS.snapshot()}, J
+        if path == "/api/v1/fabric":
+            # intra-node routing fabric state (broker/fabric.py): role,
+            # link health, directory epoch/size, submit/fan-out counters;
+            # shape-stable {"enabled": false} without a fabric
+            fab = ctx.fabric
+            body_out = (fab.snapshot() if fab is not None
+                        else {"enabled": False})
+            return 200, {"node": ctx.node_id, **body_out}, J
         if path == "/api/v1/routing/failover":
             # device-plane failover state (broker/failover.py): breaker,
             # host-routed counters, reason-labeled failures; a static
@@ -706,6 +714,11 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_cand_cache_invalidations","routing_fused_batches",
  "routing_stage_encode_ms_total","routing_stage_dispatch_ms_total",
  "routing_stage_fetch_ms_total","routing_stage_decode_ms_total",
+ "fabric_batches","fabric_items","fabric_bytes_out","fabric_deliver_in",
+ "fabric_deliver_out","fabric_kicks_o1","fabric_kick_rpcs",
+ "fabric_plan_hits","directory_epoch",
+ "routing_stage_fabric_submit_ms_total",
+ "routing_stage_fabric_fanout_ms_total",
  "device_jit_traces","device_jit_cache_hits","device_retrace_storms",
  "device_hbm_modeled_mb","routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",

@@ -129,6 +129,27 @@ class Stats:
         self.routing_switchbacks = 0
         self.routing_failover_host_routed = 0
         self.routing_device_failures = 0
+        # intra-node routing fabric gauges (broker/fabric.py), overwritten
+        # from RoutingService.stats(); zeros without a fabric so the
+        # observability surface stays shape-stable. kicks_o1 counts CONNECTs
+        # whose takeover kick resolved via the node-local directory (miss =
+        # no RPC at all, hit = one targeted kick — never a worker scatter);
+        # the stage *_ms_total keys are cumulative (summed in /stats/sum)
+        self.fabric_enabled = 0
+        self.fabric_owner = 0
+        self.fabric_batches = 0
+        self.fabric_items = 0
+        self.fabric_bytes_out = 0
+        self.fabric_deliver_in = 0
+        self.fabric_deliver_out = 0
+        self.fabric_kicks_o1 = 0
+        self.fabric_kick_rpcs = 0
+        self.fabric_plan_hits = 0
+        self.fabric_owner_reconnects = 0
+        self.fabric_submit_fallbacks = 0
+        self.directory_epoch = 0
+        self.routing_stage_fabric_submit_ms_total = 0.0
+        self.routing_stage_fabric_fanout_ms_total = 0.0
         # cluster membership + partition-healing gauges
         # (cluster/membership.py), filled by ServerContext.stats(); zeros
         # on single-node brokers so the surface stays shape-stable.

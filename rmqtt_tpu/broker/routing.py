@@ -91,6 +91,10 @@ class RoutingService:
         # for device routers with a host trie mirror; None keeps every
         # dispatch guard a single attribute test
         self.failover = None
+        # intra-node routing fabric (broker/fabric.py), wired by
+        # ServerContext when [fabric] is enabled; surfaced through stats()
+        # so the fabric counters ride every admin plane (None = zeros)
+        self.fabric = None
         # epoch-versioned match-result cache (pre-queue fast path). The
         # cache is only sound for routers that OPT IN via epochs_tracked
         # (their add/remove bump Router.epochs on every mutation); any
@@ -182,6 +186,33 @@ class RoutingService:
                 self.failover.host_items if self.failover is not None else 0),
             "routing_device_failures": (
                 self.failover.failure_total if self.failover is not None else 0),
+            # intra-node fabric gauges (broker/fabric.py): zeros without a
+            # fabric so the surface stays shape-stable. The two stage keys
+            # attribute fabric submit RTT / remote fan-out write time next
+            # to the device-stage *_ms_total gauges, keeping the
+            # host-vs-device split honest when matches cross workers
+            "fabric_enabled": 1 if self.fabric is not None else 0,
+            "fabric_owner": (
+                1 if self.fabric is not None and self.fabric.is_owner else 0),
+            "fabric_batches": self.fabric.batches if self.fabric else 0,
+            "fabric_items": self.fabric.items if self.fabric else 0,
+            "fabric_bytes_out": self.fabric.bytes_out if self.fabric else 0,
+            "fabric_deliver_in": self.fabric.deliver_in if self.fabric else 0,
+            "fabric_deliver_out": self.fabric.deliver_out if self.fabric else 0,
+            "fabric_kicks_o1": self.fabric.kicks_o1 if self.fabric else 0,
+            "fabric_kick_rpcs": self.fabric.kick_rpcs if self.fabric else 0,
+            "fabric_plan_hits": self.fabric.plan_hits if self.fabric else 0,
+            "fabric_owner_reconnects": (
+                self.fabric.owner_reconnects if self.fabric else 0),
+            "fabric_submit_fallbacks": (
+                self.fabric.submit_fallbacks if self.fabric else 0),
+            "directory_epoch": (
+                (self.fabric.dir_epoch if self.fabric.is_owner
+                 else self.fabric.replica_epoch) if self.fabric else 0),
+            "routing_stage_fabric_submit_ms_total": (
+                round(self.fabric.submit_ms_total, 3) if self.fabric else 0.0),
+            "routing_stage_fabric_fanout_ms_total": (
+                round(self.fabric.fanout_ms_total, 3) if self.fabric else 0.0),
         }
 
     def queue_fraction(self) -> float:

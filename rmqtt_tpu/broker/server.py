@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import sys
 import time
 import uuid
@@ -122,6 +123,10 @@ class MqttBroker:
     async def start(self) -> None:
         await self.ctx.hooks.fire(HookType.BEFORE_STARTUP)
         self.ctx.start()
+        if self.ctx.fabric is not None:
+            # the intra-node fabric's UDS server must listen before the
+            # client listeners accept (a CONNECT may need the directory)
+            await self.ctx.fabric.start()
         await self.ctx.plugins.start_all()
         cfg = self.ctx.cfg
         rp = {"reuse_port": True} if cfg.reuse_port else {}
@@ -565,6 +570,14 @@ async def _amain(args) -> None:
         cli.setdefault("cluster", {})["peers"] = list(args.peer)
     if args.reuse_port:
         cli.setdefault("listener", {})["reuse_port"] = True
+    if args.fabric:
+        cli.setdefault("fabric", {})["enable"] = True
+    if args.fabric_dir is not None:
+        cli.setdefault("fabric", {})["dir"] = args.fabric_dir
+    if args.fabric_worker_id is not None:
+        cli.setdefault("fabric", {})["worker_id"] = args.fabric_worker_id
+    if args.fabric_workers is not None:
+        cli.setdefault("fabric", {})["workers"] = args.fabric_workers
     settings = conf.load(args.config, cli=cli)
     # [log] section (file/console targets + level, logging.rs analogue);
     # replaces the bootstrap basicConfig from main()
@@ -610,14 +623,90 @@ async def _amain(args) -> None:
         await broker._server.serve_forever()
 
 
+def _worker_passthrough(argv: list) -> list:
+    """CLI args forwarded verbatim to each worker process (the supervisor
+    re-adds the per-worker role flags itself)."""
+    passthrough = []
+    skip = 0
+    supervisor_flags = ("--workers", "--cluster-port-base", "--fabric-dir",
+                        "--fabric-worker-id", "--fabric-workers")
+    for a in argv:
+        if skip:
+            skip -= 1
+            continue
+        if a in supervisor_flags:
+            skip = 1
+            continue
+        if a == "--fabric" or any(a.startswith(f + "=")
+                                  for f in supervisor_flags):
+            continue
+        passthrough.append(a)
+    return passthrough
+
+
+def _worker_cmds(args, argv: list, fabric_dir=None) -> list:
+    """The N worker command lines for ``--workers N``.
+
+    Without a fabric dir this is EXACTLY the historical shape — worker i
+    gets node id i+1 and peers over a localhost broadcast cluster on RPC
+    port base+i (the zero-behavior-change pin, tests/test_fabric.py). With
+    one, workers carry fabric role flags instead: same node ids, no
+    cluster peering — cross-worker routing rides the UDS mesh."""
+    n = args.workers
+    passthrough = _worker_passthrough(argv)
+    cmds = []
+    if fabric_dir is None:
+        if args.cluster_port_base:
+            base = args.cluster_port_base
+        else:
+            # the client port may come from the config file, not the CLI —
+            # resolve the effective port before deriving RPC ports off it
+            from rmqtt_tpu import conf
+
+            cli = ({"listener": {"port": args.port}}
+                   if args.port is not None else {})
+            base = conf.load(args.config, cli=cli).broker.port + 1000
+        for i in range(n):
+            cmd = [sys.executable, "-m", "rmqtt_tpu.broker", *passthrough,
+                   "--reuse-port", "--node-id", str(i + 1),
+                   "--cluster-listen", f"127.0.0.1:{base + i}",
+                   "--cluster-mode", "broadcast"]
+            for j in range(n):
+                if j != i:
+                    cmd += ["--peer", f"{j + 1}@127.0.0.1:{base + j}"]
+            if i > 0:
+                cmd.append("--no-http-api")
+            cmds.append(cmd)
+        return cmds
+    for i in range(n):
+        cmd = [sys.executable, "-m", "rmqtt_tpu.broker", *passthrough,
+               "--reuse-port", "--node-id", str(i + 1),
+               "--fabric", "--fabric-dir", fabric_dir,
+               "--fabric-worker-id", str(i + 1),
+               "--fabric-workers", str(n)]
+        if i > 0:
+            cmd.append("--no-http-api")
+        cmds.append(cmd)
+    return cmds
+
+
 def _supervise_workers(args, argv: list) -> None:
     """--workers N: spawn N broker processes sharing the client port via
     SO_REUSEPORT (kernel load-balances accepts — the multi-core analogue of
-    the reference's multi-thread tokio accept loop, server.rs:229), peered
-    as a localhost broadcast cluster for cross-worker delivery. Worker i
-    gets node id i+1 and cluster RPC port base+i; only worker 1 serves the
-    admin API. The supervisor forwards SIGTERM/SIGINT and exits when any
-    worker dies (a clean, signal-initiated stop exits 0)."""
+    the reference's multi-thread tokio accept loop, server.rs:229). Without
+    [fabric] they peer as a localhost broadcast cluster for cross-worker
+    delivery — exactly the historical behavior; with it they wire into the
+    intra-node routing fabric (broker/fabric.py: worker 1 owns the device
+    table, the rest submit over UDS). Worker i gets node id i+1; only
+    worker 1 serves the admin API. The supervisor forwards SIGTERM/SIGINT.
+
+    Death policy: in broadcast mode any unrequested worker death stops the
+    group (restart policy is external, e.g. systemd). In fabric mode the
+    supervisor RESPAWNS the dead worker — owner included: survivors detect
+    the dead owner on the UDS link, park submits, and re-register their
+    session/subscription state with the respawn, so sessions on the other
+    workers survive an owner crash. A crash loop (>5 deaths of one worker
+    inside 30s) still stops the group."""
     import signal
     import subprocess
 
@@ -625,69 +714,74 @@ def _supervise_workers(args, argv: list) -> None:
         sys.exit("--workers manages node ids and the cluster itself; it "
                  "cannot combine with --cluster-mode/--cluster-listen/"
                  "--node-id/--peer")
-    n = args.workers
-    if args.cluster_port_base:
-        base = args.cluster_port_base
-    else:
-        # the client port may come from the config file, not the CLI —
-        # resolve the effective port before deriving RPC ports off it
+    fabric_dir = None
+    fabric_tmp = None
+    fabric_on = args.fabric or args.fabric_dir
+    if not fabric_on and args.config:
         from rmqtt_tpu import conf
 
-        cli = {"listener": {"port": args.port}} if args.port is not None else {}
-        base = conf.load(args.config, cli=cli).broker.port + 1000
-    passthrough = []
-    skip = 0
-    for a in argv:
-        if skip:
-            skip -= 1
-            continue
-        if a in ("--workers", "--cluster-port-base"):
-            skip = 1
-            continue
-        if a.startswith("--workers=") or a.startswith("--cluster-port-base="):
-            continue
-        passthrough.append(a)
-    procs = []
-    for i in range(n):
-        cmd = [sys.executable, "-m", "rmqtt_tpu.broker", *passthrough,
-               "--reuse-port", "--node-id", str(i + 1),
-               "--cluster-listen", f"127.0.0.1:{base + i}",
-               "--cluster-mode", "broadcast"]
-        for j in range(n):
-            if j != i:
-                cmd += ["--peer", f"{j + 1}@127.0.0.1:{base + j}"]
-        if i > 0:
-            cmd.append("--no-http-api")
-        procs.append(subprocess.Popen(cmd))
+        fabric_on = conf.load(args.config).broker.fabric_enable
+    if fabric_on:
+        if args.fabric_dir:
+            fabric_dir = args.fabric_dir
+            os.makedirs(fabric_dir, exist_ok=True)
+        else:
+            import tempfile
+
+            fabric_dir = fabric_tmp = tempfile.mkdtemp(prefix="rmqtt-fabric-")
+    cmds = _worker_cmds(args, argv, fabric_dir=fabric_dir)
+    procs = {i: subprocess.Popen(cmd) for i, cmd in enumerate(cmds)}
+    deaths: dict = {i: [] for i in procs}  # slot → recent death times
     stopping = False
 
     def stop(_sig, _frm):
         nonlocal stopping
         stopping = True
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
 
     signal.signal(signal.SIGTERM, stop)
     signal.signal(signal.SIGINT, stop)
     rc = 0
     try:
-        while procs:
-            for p in list(procs):
+        while True:
+            alive = 0
+            for i, p in list(procs.items()):
                 r = p.poll()
-                if r is not None:
-                    procs.remove(p)
-                    if not stopping:
-                        # an unrequested worker death degrades the whole
-                        # listener group: stop the rest (restart policy is
-                        # external, e.g. systemd)
-                        rc = rc or (r if r > 0 else 1)
-                        stopping = True
-                        for q in procs:
-                            q.send_signal(signal.SIGTERM)
+                if r is None:
+                    alive += 1
+                    continue
+                if stopping:
+                    continue
+                if fabric_dir is not None:
+                    now = time.monotonic()
+                    deaths[i] = [t for t in deaths[i] if now - t < 30.0] + [now]
+                    if len(deaths[i]) <= 5:
+                        log.warning("worker %d died (rc=%s); respawning",
+                                    i + 1, r)
+                        procs[i] = subprocess.Popen(cmds[i])
+                        alive += 1
+                        continue
+                    log.error("worker %d crash-looping; stopping the group",
+                              i + 1)
+                # broadcast mode (or a crash loop): an unrequested worker
+                # death degrades the whole listener group — stop the rest
+                rc = rc or (r if r > 0 else 1)
+                stopping = True
+                for q in procs.values():
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+            if stopping and alive == 0:
+                break
             time.sleep(0.3)
     finally:
-        for p in procs:
+        for p in procs.values():
             p.wait()
+        if fabric_tmp is not None:
+            import shutil
+
+            shutil.rmtree(fabric_tmp, ignore_errors=True)
     sys.exit(rc)
 
 
@@ -714,6 +808,17 @@ def main() -> None:
                     help="set SO_REUSEPORT on the client listeners")
     ap.add_argument("--cluster-port-base", type=int, default=None,
                     help="first cluster RPC port for --workers (default port+1000)")
+    ap.add_argument("--fabric", action="store_true",
+                    help="intra-node routing fabric: with --workers, wire "
+                         "the workers to one router owner over a UDS mesh "
+                         "instead of a localhost broadcast cluster")
+    ap.add_argument("--fabric-dir", default=None,
+                    help="fabric UDS socket directory (default: a temp dir "
+                         "managed by the --workers supervisor)")
+    ap.add_argument("--fabric-worker-id", type=int, default=None,
+                    help="this process's fabric worker id (default: node id)")
+    ap.add_argument("--fabric-workers", type=int, default=None,
+                    help="expected fabric worker count (informational)")
     ap.add_argument("--no-http-api", action="store_true",
                     help="do not start the admin HTTP API in this process")
     ap.add_argument("-v", "--verbose", action="store_true")

@@ -71,6 +71,30 @@ class DeliverItem:
     trace: object = None
 
 
+def encode_qos0_frame(msg: Message, version: int, retain: bool, rem) -> bytes:
+    """The QoS0 fan-out wire frame for one (protocol version, retain flag,
+    remaining expiry) — byte-identical for every same-version subscriber (no
+    packet id, no per-subscription props, aliases disabled), so it is
+    encoded ONCE per publish and reused across the fan-out via the shared
+    ``wire_cache`` dict keyed ``(version, retain, rem)``. Shared by the
+    in-session fast path below and the intra-node fabric, which ships these
+    frames to peer workers so the whole NODE encodes each variant once."""
+    props: Dict[int, object] = {
+        k: v
+        for k, v in msg.properties.items()
+        if k in (P.PAYLOAD_FORMAT_INDICATOR, P.CONTENT_TYPE, P.RESPONSE_TOPIC,
+                 P.CORRELATION_DATA, P.USER_PROPERTY)
+    }
+    if rem is not None:
+        props[P.MESSAGE_EXPIRY_INTERVAL] = rem
+    pub = pk.Publish(
+        topic=msg.topic, payload=msg.payload, qos=0,
+        retain=retain, dup=False, packet_id=None,
+        properties=props if version == pk.V5 else {},
+    )
+    return MqttCodec(version).encode(pub)
+
+
 class Session:
     """Durable session state; survives reconnects when expiry > 0."""
 
@@ -520,12 +544,8 @@ class SessionState:
             cache = item.wire_cache
             data = cache.get(key)
             if data is None:
-                pub = pk.Publish(
-                    topic=msg.topic, payload=msg.payload, qos=0,
-                    retain=item.retain, dup=False, packet_id=None,
-                    properties=props if self.codec.version == pk.V5 else {},
-                )
-                data = cache[key] = self.codec.encode(pub)
+                data = cache[key] = encode_qos0_frame(
+                    msg, self.codec.version, item.retain, rem)
             await self.send_raw(data)
             self.ctx.metrics.inc("messages.delivered")
             if t_tr:

@@ -42,7 +42,7 @@ _FORWARD_TYPES = ("forwards", "forwards_to")  # messages.M constants
 #: membership detector (cluster/membership.py) must detect and heal from
 _FP_RPC = FAILPOINTS.register("cluster.rpc")
 
-MAX_FRAME = 8 * 1024 * 1024  # reference caps messages at 4MB (grpc.rs:154)
+MAX_FRAME = wire.MAX_FRAME  # reference caps messages at 4MB (grpc.rs:154)
 
 
 class PeerUnavailable(ConnectionError):
@@ -53,19 +53,13 @@ class ClusterReplyError(RuntimeError):
     """The peer's handler failed (its error travels as a ``__err`` reply)."""
 
 
+# length-prefixed framing shared with the intra-node fabric (cluster/wire.py)
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
-    head = await reader.readexactly(4)
-    length = int.from_bytes(head, "big")
-    if length > MAX_FRAME:
-        raise ConnectionError(f"oversized cluster frame: {length}")
-    return wire.loads(await reader.readexactly(length))
+    return await wire.read_frame(reader)
 
 
 def _frame(obj: Any) -> bytes:
-    data = wire.dumps(obj)
-    if len(data) > MAX_FRAME:
-        raise ValueError(f"oversized cluster frame: {len(data)}")
-    return len(data).to_bytes(4, "big") + data
+    return wire.frame(obj)
 
 
 # The per-peer breaker is the SHARED overload-subsystem implementation

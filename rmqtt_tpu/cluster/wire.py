@@ -12,6 +12,11 @@ from __future__ import annotations
 import struct
 from typing import Any
 
+#: shared frame cap for length-prefixed links built on this format (the
+#: cluster TCP mesh and the intra-node fabric UDS mesh both enforce it;
+#: reference caps messages at 4MB, grpc.rs:154)
+MAX_FRAME = 8 * 1024 * 1024
+
 _NONE = 0
 _TRUE = 1
 _FALSE = 2
@@ -118,6 +123,25 @@ def loads(data: bytes) -> Any:
     if c.pos != len(data):
         raise ValueError("trailing wire data")
     return obj
+
+
+def frame(obj: Any, max_frame: int = MAX_FRAME) -> bytes:
+    """One length-prefixed frame (4-byte BE length + payload) — the shared
+    primitive under every link that speaks this format (cluster transport,
+    intra-node fabric)."""
+    data = dumps(obj)
+    if len(data) > max_frame:
+        raise ValueError(f"oversized wire frame: {len(data)}")
+    return len(data).to_bytes(4, "big") + data
+
+
+async def read_frame(reader, max_frame: int = MAX_FRAME) -> Any:
+    """Read one length-prefixed frame from an asyncio StreamReader."""
+    head = await reader.readexactly(4)
+    length = int.from_bytes(head, "big")
+    if length > max_frame:
+        raise ConnectionError(f"oversized wire frame: {length}")
+    return loads(await reader.readexactly(length))
 
 
 def _dec(c: _Cursor, depth: int = 0) -> Any:

@@ -330,6 +330,21 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "failover_max_cooldown": ("failover_max_cooldown", float),
         "failover_k_successes": ("failover_k_successes", int),
     }, broker_kwargs)
+    # [fabric] — intra-node routing fabric (broker/fabric.py): one router
+    # owner per node serving every SO_REUSEPORT worker over a UDS mesh.
+    # `--workers N` arms this per worker automatically when enabled; the
+    # dir/worker_id/owner_id knobs matter for hand-wired topologies.
+    _apply_section(tree, "fabric", {
+        "enable": ("fabric_enable", bool),
+        "dir": ("fabric_dir", str),
+        "worker_id": ("fabric_worker_id", int),
+        "owner_id": ("fabric_owner_id", int),
+        "workers": ("fabric_workers", int),
+        "batch_max": ("fabric_batch_max", int),
+        "call_timeout_s": ("fabric_call_timeout_s", float),
+        "submit_deadline_s": ("fabric_submit_deadline_s", float),
+        "warm_grace_s": ("fabric_warm_grace_s", float),
+    }, broker_kwargs)
     # [failpoints] — fault-injection sites (utils/failpoints.py): quoted
     # site name → action spec. Validated at load (unknown sites / bad specs
     # raise when ServerContext applies them); listed here as a free-form

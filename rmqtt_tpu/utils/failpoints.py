@@ -65,6 +65,8 @@ SITES: List[Tuple[str, str]] = [
     ("cluster.forward", "cross-node publish forwarding (broadcast + raft)"),
     ("cluster.rpc", "every cluster frame, both directions (partition: "
                     "outbound fails fast, inbound is blackholed)"),
+    ("fabric.submit", "intra-node fabric publish submission to the router "
+                      "owner (failure degrades to worker-local match)"),
     ("bridge.egress", "bridge producer sends (kafka/pulsar/nats egress pumps)"),
 ]
 

@@ -359,6 +359,60 @@ async def cell_cluster_node_kill() -> dict:
                 n.stop()
 
 
+async def cell_fabric(site: str, action: str) -> dict:
+    """Intra-node fabric submit fault: a 2-worker UDS fabric under live
+    traffic; the armed site degrades worker 2's publishes to local-only
+    match (same-worker subscriber still served, publisher acked, never a
+    wedge) and cross-worker delivery resumes once disarmed."""
+    import tempfile
+
+    td = tempfile.mkdtemp(prefix="cm-fabric-")
+    workers = []
+    try:
+        for wid in (1, 2):
+            b = MqttBroker(ServerContext(BrokerConfig(
+                port=0, node_id=wid, fabric_enable=True, fabric_dir=td,
+                fabric_worker_id=wid, fabric_workers=2)))
+            await b.start()
+            workers.append(b)
+        deadline = time.time() + 10
+        while not workers[1].ctx.fabric._owner_up.is_set():
+            assert time.time() < deadline, "worker never registered"
+            await asyncio.sleep(0.05)
+        sub_local = await TestClient.connect(workers[1].port, "cmf-l")
+        await sub_local.subscribe("f/#", qos=1)
+        sub_remote = await TestClient.connect(workers[0].port, "cmf-r")
+        await sub_remote.subscribe("f/#", qos=1)
+        pub = await TestClient.connect(workers[1].port, "cmf-p")
+        await pub.publish("f/warm", b"w", qos=1)
+        assert (await sub_remote.recv(timeout=10.0)).payload == b"w"
+        await sub_local.recv(timeout=10.0)
+        fp = FAILPOINTS.point(site)
+        base = fp.triggers
+        FAILPOINTS.set(site, action)
+        await pub.publish("f/hit", b"h", qos=1)  # acked, locally served
+        local_ok = (await sub_local.recv(timeout=10.0)).payload == b"h"
+        FAILPOINTS.set(site, "off")
+        await pub.publish("f/after", b"a", qos=1)
+        got = set()
+        deadline = time.time() + 8
+        while time.time() < deadline and b"a" not in got:
+            try:
+                got.add((await sub_remote.recv(timeout=1.0)).payload)
+            except asyncio.TimeoutError:
+                break
+        fallbacks = workers[1].ctx.fabric.submit_fallbacks
+        return {"ok": (b"a" in got and local_ok and fp.triggers > base
+                       and fallbacks >= 1),
+                "triggers": fp.triggers - base,
+                "submit_fallbacks": fallbacks,
+                "delivered_after": sorted(g.decode() for g in got)}
+    finally:
+        FAILPOINTS.clear_all()
+        for b in workers:
+            await b.stop()
+
+
 async def cell_bridge(site: str, action: str) -> dict:
     from rmqtt_tpu.plugins.bridge_mqtt import BridgeEgressMqttPlugin
 
@@ -415,12 +469,14 @@ MATRIX = {
     "cluster.rpc:partition": lambda: cell_cluster_partition("cluster.rpc", "error"),
     "cluster.rpc:node_kill": lambda: cell_cluster_node_kill(),
     "bridge.egress:error": lambda: cell_bridge("bridge.egress", "times(1, error)"),
+    "fabric.submit:error": lambda: cell_fabric("fabric.submit", "times(1, error)"),
 }
 
 #: tier-1 subset (fast, no hang/delay/subprocess cells): run by
 #: tests/test_failpoints.py
 FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
-               "bridge.egress:error", "cluster.rpc:partition"]
+               "bridge.egress:error", "cluster.rpc:partition",
+               "fabric.submit:error"]
 
 
 async def run_matrix(cells=None) -> dict:

@@ -1,0 +1,502 @@
+"""Intra-node routing fabric (broker/fabric.py): one router owner per node,
+per-worker UDS links, batched publish submission, zero-copy QoS0 fan-out,
+and the node-local subscription directory (O(1) CONNECT kicks).
+
+In-process tier: several ServerContexts in one loop wired over REAL UDS
+sockets — deterministic client placement (each worker has its own port),
+every fabric path exercised without subprocess overhead. The multi-process
+tier lives in tests/test_fabric_procs.py.
+"""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.server import MqttBroker
+from rmqtt_tpu.core.topic import match_filter
+
+from tests.mqtt_client import TestClient
+
+
+def run_async(fn, timeout=90.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+def build_worker(wid: int, fabric_dir: str, **cfg) -> MqttBroker:
+    return MqttBroker(ServerContext(BrokerConfig(
+        port=0, node_id=wid, fabric_enable=True, fabric_dir=fabric_dir,
+        fabric_worker_id=wid, fabric_workers=3, **cfg)))
+
+
+async def start_fabric(n=3, **cfg):
+    td = tempfile.mkdtemp(prefix="fab-test-")
+    workers = []
+    for wid in range(1, n + 1):
+        b = build_worker(wid, td, **cfg)
+        await b.start()
+        workers.append(b)
+    # workers register with the owner (worker 1)
+    deadline = asyncio.get_running_loop().time() + 10.0
+    while asyncio.get_running_loop().time() < deadline:
+        if all(w.ctx.fabric.is_owner or w.ctx.fabric._owner_up.is_set()
+               for w in workers):
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise AssertionError("workers never registered with the owner")
+    return td, workers
+
+
+async def stop_all(workers):
+    for w in workers:
+        await w.stop()
+
+
+def test_fabric_cross_worker_delivery_oracle():
+    """QoS0 + QoS1 across all three workers, checked against a per-
+    subscriber filter-match oracle: nothing lost, nothing misrouted,
+    nothing extra — with publishers on the owner AND on a plain worker."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            specs = {  # cid → (worker index, filter, qos)
+                "fo-w1": (0, "tele/+/temp", 1),
+                "fo-w2": (1, "tele/#", 0),
+                "fo-w3": (2, "tele/1/temp", 1),
+            }
+            subs = {}
+            for cid, (wi, filt, qos) in specs.items():
+                c = await TestClient.connect(workers[wi].port, cid)
+                ack = await c.subscribe(filt, qos=qos)
+                assert ack.reason_codes[0] < 0x80
+                subs[cid] = c
+            pub_owner = await TestClient.connect(workers[0].port, "fp-own")
+            pub_w2 = await TestClient.connect(workers[1].port, "fp-w2")
+            sent = []
+            for i in range(12):
+                topic = f"tele/{i % 3}/temp"
+                payload = f"m-{i}".encode()
+                pub = pub_owner if i % 2 == 0 else pub_w2
+                await pub.publish(topic, payload, qos=i % 2)
+                sent.append((topic, payload))
+            for cid, (wi, filt, _qos) in specs.items():
+                expect = {(t, p) for t, p in sent if match_filter(filt, t)}
+                got = set()
+                while len(got) < len(expect):
+                    p = await subs[cid].recv(timeout=10.0)
+                    got.add((p.topic, p.payload))
+                assert got == expect, cid
+                await subs[cid].expect_nothing(timeout=0.3)
+            # the fabric actually carried this: the owner matched batches
+            # for worker 2's publishes (repeat topics may serve from the
+            # worker plan cache instead), peers exchanged deliver frames
+            f2 = workers[1].ctx.fabric
+            assert f2.batches >= 1 and f2.items + f2.plan_hits >= 6
+            assert f2.deliver_out >= 1
+            assert workers[0].ctx.fabric.deliver_in >= 1
+            for c in [*subs.values(), pub_owner, pub_w2]:
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_qos0_frame_encoded_once_node_wide(monkeypatch):
+    """The zero-copy pin: one QoS0 publish fanning out to subscribers on
+    TWO other workers encodes its wire frame exactly once — the deliver
+    frames ship the encoded bytes and receivers seed their wire_cache."""
+    import rmqtt_tpu.broker.session as session_mod
+
+    calls = []
+    real = session_mod.encode_qos0_frame
+
+    def counting(msg, version, retain, rem):
+        calls.append((msg.topic, version, retain))
+        return real(msg, version, retain, rem)
+
+    monkeypatch.setattr(session_mod, "encode_qos0_frame", counting)
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            subs = []
+            for wi in (0, 2):  # owner + worker 3; publisher on worker 2
+                for k in range(2):
+                    c = await TestClient.connect(
+                        workers[wi].port, f"z-{wi}-{k}")
+                    await c.subscribe("zc/#", qos=0)
+                    subs.append(c)
+            pub = await TestClient.connect(workers[1].port, "z-pub")
+            calls.clear()
+            await pub.publish("zc/t", b"once", qos=0, wait_ack=False)
+            for c in subs:
+                p = await c.recv(timeout=10.0)
+                assert p.payload == b"once"
+            encodes = [c for c in calls if c[0] == "zc/t"]
+            assert len(encodes) == 1, (
+                f"expected ONE node-wide encode, saw {encodes}")
+            for c in [*subs, pub]:
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_kick_o1_via_directory():
+    """CONNECT-time kicks ride the directory replica: a fresh client id is
+    ZERO RPCs, a takeover is ONE targeted kick to the owning worker —
+    never an O(workers) scatter — and resumable session state transfers."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            from rmqtt_tpu.broker.codec import packets as pk, props as P
+
+            f3 = workers[2].ctx.fabric
+            # durable session with a subscription lives on worker 2
+            c1 = await TestClient.connect(
+                workers[1].port, "kick-me", version=pk.V5, clean_start=False,
+                properties={P.SESSION_EXPIRY_INTERVAL: 600})
+            await c1.subscribe("kick/t", qos=1)
+            # replica convergence: worker 3 sees the directory entry
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while f3.directory_entry("kick-me") is None:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            # fresh client id: the directory miss is NO RPC at all
+            base_rpcs, base_o1 = f3.kick_rpcs, f3.kicks_o1
+            fresh = await TestClient.connect(workers[2].port, "never-seen")
+            assert f3.kick_rpcs == base_rpcs
+            assert f3.kicks_o1 == base_o1 + 1
+            # takeover from worker 3: exactly ONE targeted kick RPC
+            dup = await TestClient.connect(
+                workers[2].port, "kick-me", version=pk.V5, clean_start=False,
+                properties={P.SESSION_EXPIRY_INTERVAL: 600})
+            assert f3.kick_rpcs == base_rpcs + 1
+            assert dup.connack.session_present, "session state not transferred"
+            await asyncio.wait_for(c1.closed.wait(), timeout=5.0)
+            # the transferred subscription is live on worker 3 now
+            pub = await TestClient.connect(workers[0].port, "kick-pub")
+            await pub.publish("kick/t", b"after-move", qos=1)
+            p = await dup.recv(timeout=10.0)
+            assert p.payload == b"after-move"
+            for c in (fresh, dup, pub):
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_shared_subscription_cross_worker():
+    """$share group with members on two workers: the OWNER makes the global
+    choice per publish, so exactly one member receives each message."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            m1 = await TestClient.connect(workers[1].port, "sh-1")
+            await m1.subscribe("$share/g/sh/t", qos=1)
+            m2 = await TestClient.connect(workers[2].port, "sh-2")
+            await m2.subscribe("$share/g/sh/t", qos=1)
+            await asyncio.sleep(0.2)
+            pub = await TestClient.connect(workers[0].port, "sh-pub")
+            n = 10
+            for i in range(n):
+                await pub.publish("sh/t", f"s-{i}".encode(), qos=1)
+            got = []
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while (len(got) < n
+                   and asyncio.get_running_loop().time() < deadline):
+                for m in (m1, m2):
+                    try:
+                        got.append((await m.recv(timeout=0.3)).payload)
+                    except asyncio.TimeoutError:
+                        pass
+            assert sorted(got) == sorted(
+                f"s-{i}".encode() for i in range(n)), (
+                "shared group must deliver each publish exactly once")
+            for c in (m1, m2, pub):
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_owner_outage_fallback_and_recovery():
+    """Owner death: local delivery degrades gracefully past the submit
+    deadline, parked cross-worker publishes flow after the owner respawns
+    (directory + table rebuilt from worker re-registration), and no acked
+    publish is lost."""
+
+    async def run():
+        td, workers = await start_fabric(fabric_submit_deadline_s=1.0)
+        try:
+            sub3 = await TestClient.connect(workers[2].port, "ow-s3")
+            await sub3.subscribe("ow/#", qos=1)
+            sub2 = await TestClient.connect(workers[1].port, "ow-s2")
+            await sub2.subscribe("ow/#", qos=1)
+            pub = await TestClient.connect(workers[1].port, "ow-pub")
+            await pub.publish("ow/pre", b"pre", qos=1)
+            for s in (sub3, sub2):
+                assert (await s.recv(timeout=10.0)).payload == b"pre"
+            # ---- owner dies
+            await workers[0].stop()
+            f2 = workers[1].ctx.fabric
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while f2._owner_up.is_set():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            # past the 1s deadline the publish degrades to local-only:
+            # the same-worker subscriber still gets it, the publisher
+            # still gets its PUBACK (no wedge), and it is counted
+            await pub.publish("ow/during", b"during", qos=1)
+            assert (await sub2.recv(timeout=10.0)).payload == b"during"
+            assert f2.submit_fallbacks >= 1
+            # ---- owner respawns; workers re-register
+            owner2 = build_worker(1, td, fabric_submit_deadline_s=1.0)
+            await owner2.start()
+            workers[0] = owner2
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while not f2._owner_up.is_set():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            # cross-worker routing is back, table rebuilt from replicas
+            await pub.publish("ow/post", b"post", qos=1)
+            assert (await sub2.recv(timeout=10.0)).payload == b"post"
+            assert (await sub3.recv(timeout=10.0)).payload == b"post"
+            snap = owner2.ctx.fabric.snapshot()
+            assert snap["directory"]["size"] >= 3  # sub2/sub3/pub re-homed
+            for c in (sub3, sub2, pub):
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run, timeout=120.0)
+
+
+def test_fabric_zero_behavior_change_without_fabric():
+    """The pin: without [fabric] nothing is constructed — plain registry,
+    no fabric service, shape-stable zero gauges, and the --workers
+    supervisor builds EXACTLY the historical broadcast-peering commands."""
+    from types import SimpleNamespace
+
+    from rmqtt_tpu.broker.server import _worker_cmds
+    from rmqtt_tpu.broker.shared import SessionRegistry
+
+    ctx = ServerContext(BrokerConfig(port=0))
+    assert ctx.fabric is None
+    assert type(ctx.registry) is SessionRegistry
+    stats = ctx.stats().to_json()
+    assert stats["fabric_enabled"] == 0
+    assert stats["fabric_batches"] == 0
+    assert stats["fabric_kicks_o1"] == 0
+    assert stats["directory_epoch"] == 0
+    assert stats["routing_stage_fabric_submit_ms_total"] == 0.0
+
+    args = SimpleNamespace(workers=2, cluster_port_base=2883, port=1883,
+                           config=None)
+    argv = ["--port", "1883", "--workers", "2", "--cluster-port-base", "2883"]
+    cmds = _worker_cmds(args, argv, fabric_dir=None)
+    # historical shape: broadcast cluster peering, no fabric flags
+    for i, cmd in enumerate(cmds):
+        assert "--fabric" not in cmd
+        assert "--cluster-mode" in cmd and "broadcast" in cmd
+        assert f"--cluster-listen" in cmd
+        assert cmd[cmd.index("--node-id") + 1] == str(i + 1)
+    assert "--peer" in cmds[0] and "2@127.0.0.1:2884" in cmds[0]
+    assert "--no-http-api" in cmds[1] and "--no-http-api" not in cmds[0]
+    # fabric shape: role flags, NO cluster peering
+    fcmds = _worker_cmds(args, argv, fabric_dir="/tmp/fab")
+    for cmd in fcmds:
+        assert "--fabric" in cmd and "--cluster-mode" not in cmd
+        assert "--peer" not in cmd
+
+    # [fabric] + [cluster] in one process is a config error, not a
+    # silently-wrong topology
+    with pytest.raises(ValueError):
+        ServerContext(BrokerConfig(port=0, fabric_enable=True,
+                                   fabric_dir="/tmp/x", cluster=True))
+    with pytest.raises(ValueError):
+        ServerContext(BrokerConfig(port=0, fabric_enable=True))
+
+
+def test_fabric_conf_section(tmp_path):
+    """[fabric] knobs load like every other flat section; typos raise."""
+    from rmqtt_tpu import conf
+
+    p = tmp_path / "f.toml"
+    p.write_text("""
+[fabric]
+enable = true
+dir = "/tmp/fabsock"
+worker_id = 3
+owner_id = 1
+workers = 4
+batch_max = 128
+submit_deadline_s = 7.5
+""")
+    s = conf.load(str(p))
+    b = s.broker
+    assert b.fabric_enable and b.fabric_dir == "/tmp/fabsock"
+    assert b.fabric_worker_id == 3 and b.fabric_owner_id == 1
+    assert b.fabric_workers == 4 and b.fabric_batch_max == 128
+    assert b.fabric_submit_deadline_s == 7.5
+    p.write_text("[fabric]\nenabled = true\n")
+    with pytest.raises(ValueError):
+        conf.load(str(p))
+
+
+def test_fabric_submit_failpoint_degrades_to_local():
+    """The fabric.submit chaos seam: armed, a worker's publishes degrade to
+    local-only match (same-worker subscribers still served, publisher never
+    wedges); disarmed, cross-worker delivery resumes."""
+    from rmqtt_tpu.utils.failpoints import FAILPOINTS
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            sub_local = await TestClient.connect(workers[1].port, "fpl")
+            await sub_local.subscribe("fp/#", qos=1)
+            sub_remote = await TestClient.connect(workers[2].port, "fpr")
+            await sub_remote.subscribe("fp/#", qos=1)
+            pub = await TestClient.connect(workers[1].port, "fpp")
+            await pub.publish("fp/warm", b"w", qos=1)
+            assert (await sub_local.recv(timeout=10.0)).payload == b"w"
+            assert (await sub_remote.recv(timeout=10.0)).payload == b"w"
+            fp = FAILPOINTS.point("fabric.submit")
+            base = fp.triggers
+            FAILPOINTS.set("fabric.submit", "times(1, error)")
+            await pub.publish("fp/hit", b"h", qos=1)  # acked, local-served
+            assert (await sub_local.recv(timeout=10.0)).payload == b"h"
+            assert fp.triggers == base + 1
+            assert workers[1].ctx.fabric.submit_fallbacks >= 1
+            FAILPOINTS.set("fabric.submit", "off")
+            await pub.publish("fp/after", b"a", qos=1)
+            assert (await sub_local.recv(timeout=10.0)).payload == b"a"
+            # remote subscriber: missed the degraded one, gets the next
+            got = set()
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while (b"a" not in got
+                   and asyncio.get_running_loop().time() < deadline):
+                try:
+                    got.add((await sub_remote.recv(timeout=1.0)).payload)
+                except asyncio.TimeoutError:
+                    pass
+            assert b"a" in got
+            for c in (sub_local, sub_remote, pub):
+                await c.close()
+        finally:
+            FAILPOINTS.clear_all()
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_attach_conflict_arbitration():
+    """Two near-simultaneous CONNECTs for one client id on two workers can
+    both win their directory-miss kick check; the OWNER arbitrates — the
+    later attach kicks the earlier copy, and the loser's detach must not
+    erase the winner's directory row (wid-guarded)."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            c2 = await TestClient.connect(workers[1].port, "race-cid")
+            await asyncio.sleep(0.2)
+            owner = workers[0].ctx.fabric
+            assert owner.directory["race-cid"][0] == 2
+            # simulate worker 3 winning its (stale) directory-miss check and
+            # attaching the same cid without a prior kick
+            await workers[2].ctx.fabric.attach("race-cid", ver=4)
+            # the owner kicks worker 2's copy; the winner's row survives
+            await asyncio.wait_for(c2.closed.wait(), timeout=10.0)
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while workers[1].ctx.registry.get("race-cid") is not None:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(0.3)  # the loser's detach round-trips
+            assert owner.directory.get("race-cid", [None])[0] == 3, (
+                "loser's detach erased the winner's directory row")
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_plan_cache_hits_and_invalidation():
+    """The worker-side fan-out plan cache: repeat publishes to a hot topic
+    serve their plan with ZERO submit RPCs, and a table mutation anywhere
+    on the node (a NEW subscriber on another worker) invalidates it — the
+    next publish re-plans and reaches the new subscriber."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            f2 = workers[1].ctx.fabric
+            sub3 = await TestClient.connect(workers[2].port, "pc-s3")
+            await sub3.subscribe("pc/#", qos=1)
+            await asyncio.sleep(0.2)
+            pub = await TestClient.connect(workers[1].port, "pc-pub")
+            for i in range(6):
+                await pub.publish("pc/hot", f"h-{i}".encode(), qos=1)
+            for i in range(6):
+                assert (await sub3.recv(timeout=10.0)).payload == f"h-{i}".encode()
+            assert f2.plan_hits >= 4, (
+                f"hot topic should serve from the plan cache, "
+                f"hits={f2.plan_hits}")
+            hits_before = f2.plan_hits
+            # a NEW subscriber on the OWNER worker invalidates the plan
+            late = await TestClient.connect(workers[0].port, "pc-late")
+            await late.subscribe("pc/hot", qos=1)
+            # generation push propagates to worker 2
+            gen = workers[0].ctx.fabric.table_gen
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while f2.remote_gen < gen:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            await pub.publish("pc/hot", b"after-sub", qos=1)
+            assert (await late.recv(timeout=10.0)).payload == b"after-sub", (
+                "stale cached plan served past the generation bump")
+            assert (await sub3.recv(timeout=10.0)).payload == b"after-sub"
+            # and the re-planned entry caches again
+            for i in range(4):
+                await pub.publish("pc/hot", f"r-{i}".encode(), qos=1)
+            for i in range(4):
+                await late.recv(timeout=10.0)
+                await sub3.recv(timeout=10.0)
+            assert f2.plan_hits > hits_before
+            for c in (sub3, late, pub):
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)
+
+
+def test_fabric_retained_replicates_across_workers():
+    """A retained publish ingressing one worker replays to subscribers
+    landing on any other worker (owner-relayed replication)."""
+
+    async def run():
+        _td, workers = await start_fabric()
+        try:
+            pub = await TestClient.connect(workers[1].port, "rt-pub")
+            await pub.publish("rt/keep", b"v1", qos=1, retain=True)
+            await asyncio.sleep(0.3)  # replication settles
+            late = await TestClient.connect(workers[2].port, "rt-late")
+            await late.subscribe("rt/#")
+            p = await late.recv(timeout=10.0)
+            assert p.payload == b"v1" and p.retain
+            for c in (pub, late):
+                await c.close()
+        finally:
+            await stop_all(workers)
+
+    run_async(run)

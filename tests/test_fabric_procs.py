@@ -1,0 +1,263 @@
+"""Multi-PROCESS fabric tests: real ``python -m rmqtt_tpu.broker`` worker
+processes wired over real UDS sockets (the deployment shape of the
+intra-node routing fabric), driven black-box through their listeners.
+
+Covers the ISSUE-11 acceptance scenario: 3 workers, cross-worker QoS0/QoS1
+delivery against a per-subscriber oracle, directory-based takeover across
+processes, and owner SIGKILL + respawn with ZERO acked loss (submits park
+on the dead link, the respawned owner rebuilds its table from worker
+re-registration). Plus the ``--workers N --fabric`` supervisor path
+(SO_REUSEPORT shared port, supervisor-managed socket dir + respawn).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.mqtt_client import TestClient
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p
+    )
+    return env
+
+
+def _spawn_fabric_worker(wid: int, port: int, fabric_dir: str,
+                         n: int = 3) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "rmqtt_tpu.broker",
+           "--port", str(port), "--node-id", str(wid),
+           "--fabric", "--fabric-dir", fabric_dir,
+           "--fabric-worker-id", str(wid), "--fabric-workers", str(n)]
+    if wid > 1:
+        cmd.append("--no-http-api")
+    return subprocess.Popen(cmd, env=_env(), stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _wait_port(port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never opened")
+
+
+def _stop_all(procs: dict) -> dict:
+    errs = {}
+    for i, proc in procs.items():
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    for i, proc in procs.items():
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+        if proc.stderr is not None:
+            tail = proc.stderr.read()[-2000:]
+            if tail and "Traceback" in tail:
+                errs[i] = tail
+    return errs
+
+
+@pytest.mark.timeout(240)
+def test_three_process_fabric_uds(tmp_path):
+    """3 real worker processes over real UDS: cross-worker QoS0/QoS1 with
+    a per-subscriber oracle, directory takeover across processes, then
+    owner SIGKILL + respawn with zero acked loss."""
+    from rmqtt_tpu.broker.codec import packets as pk, props as P
+    from rmqtt_tpu.core.topic import match_filter
+
+    fdir = str(tmp_path / "fab")
+    os.makedirs(fdir)
+    mports = _free_ports(3)
+    procs = {}
+
+    async def drive():
+        # ---- per-subscriber oracle: filters on all three workers
+        specs = {"pr-s1": (0, "pr/+/t", 1), "pr-s2": (1, "pr/#", 0),
+                 "pr-s3": (2, "pr/1/t", 1)}
+        subs = {}
+        for cid, (wi, filt, qos) in specs.items():
+            c = await TestClient.connect(mports[wi], cid)
+            ack = await c.subscribe(filt, qos=qos)
+            assert ack.reason_codes[0] < 0x80
+            subs[cid] = c
+        pub = await TestClient.connect(mports[1], "pr-pub")
+        await asyncio.sleep(0.3)  # sub replication to the owner settles
+        sent = []
+        for i in range(12):
+            topic = f"pr/{i % 3}/t"
+            payload = f"m-{i}".encode()
+            await pub.publish(topic, payload, qos=i % 2)
+            sent.append((topic, payload))
+        for cid, (wi, filt, _q) in specs.items():
+            expect = {(t, p) for t, p in sent if match_filter(filt, t)}
+            got = set()
+            while len(got) < len(expect):
+                p = await subs[cid].recv(timeout=15.0)
+                got.add((p.topic, p.payload))
+            assert got == expect, cid
+            await subs[cid].expect_nothing(timeout=0.3)
+
+        # ---- cross-process directory takeover (no kick scatter exists to
+        # fall back on: there IS no cluster here — only the fabric)
+        mover = await TestClient.connect(
+            mports[1], "pr-mover", version=pk.V5, clean_start=False,
+            properties={P.SESSION_EXPIRY_INTERVAL: 600})
+        await mover.subscribe("mv/t", qos=1)
+        await asyncio.sleep(0.3)
+        moved = await TestClient.connect(
+            mports[2], "pr-mover", version=pk.V5, clean_start=False,
+            properties={P.SESSION_EXPIRY_INTERVAL: 600})
+        assert moved.connack.session_present, "state did not transfer"
+        await asyncio.wait_for(mover.closed.wait(), timeout=10.0)
+        await pub.publish("mv/t", b"to-w3", qos=1)
+        assert (await moved.recv(timeout=15.0)).payload == b"to-w3"
+
+        # ---- owner SIGKILL + respawn: zero acked loss. The QoS1 stream
+        # keeps publishing through the outage; publishes that time out
+        # client-side are retried and only counted when ACKED. Submits
+        # park on the dead UDS link and flush after re-register.
+        procs[1].kill()
+        procs[1].wait(timeout=10)
+        acked, seq = [], 0
+
+        async def stream_until(stop_at: float):
+            nonlocal seq
+            while asyncio.get_running_loop().time() < stop_at:
+                payload = f"ok-{seq}".encode()
+                try:
+                    await pub.publish("pr/1/t", payload, qos=1)
+                    acked.append(payload)
+                except asyncio.TimeoutError:
+                    await asyncio.sleep(0.1)
+                seq += 1
+                await asyncio.sleep(0.05)
+
+        t_resume = asyncio.get_running_loop().time() + 1.0
+        await stream_until(t_resume)  # a second of outage traffic
+        procs[1] = _spawn_fabric_worker(1, mports[0], fdir)
+        await asyncio.get_running_loop().run_in_executor(
+            None, _wait_port, mports[0])
+        await stream_until(asyncio.get_running_loop().time() + 2.0)
+        assert acked, "no publish was ever acked through the outage"
+        # zero acked loss for the SURVIVING workers' subscribers (pr-s3 on
+        # worker 3 matches pr/1/t at QoS1). pr-s1 lived on the killed
+        # owner process — its session died with it, by design.
+        want = set(acked)
+        got = set()
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while (not want <= got
+               and asyncio.get_running_loop().time() < deadline):
+            try:
+                got.add((await subs["pr-s3"].recv(timeout=1.0)).payload)
+            except asyncio.TimeoutError:
+                pass
+        missing = want - got
+        assert not missing, (
+            f"pr-s3: {len(missing)}/{len(want)} acked messages lost "
+            f"across the owner kill: {sorted(missing)[:5]}")
+        for c in [*subs.values(), pub, moved]:
+            await c.close()
+
+    try:
+        for wid in (1, 2, 3):
+            procs[wid] = _spawn_fabric_worker(wid, mports[wid - 1], fdir)
+        for p in mports:
+            _wait_port(p)
+        time.sleep(1.0)  # workers register with the owner
+        asyncio.run(asyncio.wait_for(drive(), timeout=180.0))
+    finally:
+        errs = _stop_all(procs)
+        assert not errs, f"worker stderr tracebacks: {errs}"
+
+
+@pytest.mark.timeout(120)
+def test_workers_fabric_supervisor_shared_port():
+    """``--workers 2 --fabric``: the supervisor wires the SO_REUSEPORT
+    workers into the fabric (no cluster flags) and cross-worker fan-out
+    still reaches every subscriber wherever the kernel placed it."""
+    port = 18881
+
+    def _pkt(t, payload):
+        return bytes([t, len(payload)]) + payload
+
+    def _connect(cid):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        vh = (b"\x00\x04MQTT\x04\x02\x00\x3c"
+              + len(cid).to_bytes(2, "big") + cid)
+        s.sendall(_pkt(0x10, vh))
+        assert s.recv(4)[0] == 0x20
+        return s
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rmqtt_tpu.broker", "--port", str(port),
+         "--workers", "2", "--fabric"],
+        env=_env(), stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(240):
+            try:
+                _connect(b"probe").close()
+                break
+            except OSError:
+                time.sleep(0.25)
+        else:
+            pytest.fail("fabric workers never came up")
+        time.sleep(1.5)  # workers register with the owner
+        subs = []
+        for i in range(16):
+            s = _connect(b"fs%d" % i)
+            s.sendall(_pkt(0x82, b"\x00\x01\x00\x07fport/+\x00"))
+            assert s.recv(5)[0] == 0x90
+            s.settimeout(8)
+            subs.append(s)
+        time.sleep(0.5)
+        pubs = [_connect(b"fp%d" % i) for i in range(4)]
+        t = b"fport/news"
+        for i, p in enumerate(pubs):
+            p.sendall(_pkt(0x30, len(t).to_bytes(2, "big") + t + b"m%d" % i))
+        got = 0
+        for s in subs:
+            buf = b""
+            deadline = time.time() + 10
+            while buf.count(t) < len(pubs) and time.time() < deadline:
+                try:
+                    buf += s.recv(4096)
+                except socket.timeout:
+                    break
+            got += buf.count(t)
+        assert got == len(subs) * len(pubs), f"only {got} fabric deliveries"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
