@@ -439,7 +439,9 @@ class ScenarioBroker:
             self._inproc_broker, self._inproc_api = broker, api
         else:
             env = dict(os.environ, JAX_PLATFORMS="cpu", **self.profile.env)
-            log_f = open(Path(self.workdir) / "broker.log", "wb")
+            # append: a crash-torture restart must not truncate the
+            # killed process's log (it is the post-mortem)
+            log_f = open(Path(self.workdir) / "broker.log", "ab")
             self.proc = subprocess.Popen(
                 [sys.executable, "-m", "rmqtt_tpu.broker",
                  "--config", str(conf_path)],
@@ -471,6 +473,15 @@ class ScenarioBroker:
         if status != 200:
             raise RuntimeError(f"{method} {path} -> {status}: {body}")
         return body
+
+    def kill(self) -> None:
+        """SIGKILL the broker subprocess — no shutdown path runs, no
+        flush, no goodbyes (the crash-torture primitive). ``start()``
+        again restarts it on the same ports and workdir."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+            self.proc = None
 
     async def stop(self) -> None:
         if self.inproc:
@@ -1328,6 +1339,302 @@ _profile(Profile(
     steps=(),
     subprocess_only=True,
     runner=run_cluster_partition_heal,
+))
+
+
+# --------------------------------------------- crash-torture (durability)
+_DURABILITY_TOML = """
+[durability]
+enable = true
+path = "{workdir}/durability.db"
+flush_interval_ms = 20.0
+compact_min = 192
+"""
+
+
+def _retained_matches(oracle: dict, got: Dict[str, str]) -> bool:
+    """Retained-store vs client-side oracle, honoring the maybe-applied
+    window: a set whose PUBACK the kill swallowed may legitimately have
+    landed, so for those topics EITHER the last-acked value or the
+    unacked candidate is correct. On a match the oracle re-anchors to
+    the observed store so later rounds compare exactly."""
+    maybe = oracle["retained_maybe"]
+    expected = oracle["retained"]
+    for topic in set(expected) | set(got) | set(maybe):
+        have = got.get(topic)
+        want = expected.get(topic)
+        if have == want:
+            continue
+        if have is not None and have in maybe.get(topic, ()):
+            continue  # the unacked set landed after all
+        return False
+    oracle["retained"] = dict(got)
+    oracle["retained_maybe"] = {}
+    return True
+
+
+async def crash_torture_round(broker: "ScenarioBroker", oracle: dict, *,
+                              rnd: int, rng, msgs: int = 60,
+                              qos2_every: int = 3, retain_every: int = 5,
+                              torn: bool = False,
+                              recovery_bound_ms: float = 30000.0) -> dict:
+    """One kill-9 round against a live durability-enabled broker.
+
+    Live QoS1/2 + retained traffic, SIGKILL at a randomized point mid-
+    stream (with ``flush_interval_ms = 20`` the kill regularly lands
+    inside an open commit window; ``torn`` additionally arms
+    ``storage.torn_write`` over the live HTTP API so the journal wedges
+    with a truncated tail record), restart, then verify the durability
+    invariants against client-side oracles:
+
+    - **zero acked loss** — every publish the broker PUBACK/PUBCOMP'd
+      reaches the durable subscriber after the restart;
+    - **duplicates only with DUP=1** — a payload received twice must carry
+      the DUP flag on the re-receipt;
+    - **retained equality** — a fresh subscriber's retained replay matches
+      the oracle's topic → last-acked-payload map exactly;
+    - **bounded recovery** — ``durability_recovery_ms`` under the bound.
+
+    The oracle dict accumulates ACROSS rounds (``acked``/``received``/
+    ``retained``/``violations``) so state built in round N is still held
+    to account in round N+k.
+    """
+    acked: set = oracle["acked"]
+    received: Dict[str, List[bool]] = oracle["received"]
+    sub = await MiniClient.connect(broker.port, "tortoise",
+                                   clean_start=False)
+    await sub.subscribe("t/#", qos=2)
+    pub = await MiniClient.connect(broker.port, f"torture-pub-{rnd}")
+
+    def _record(p) -> None:
+        payload = p.payload.decode()
+        seen = received.setdefault(payload, [])
+        if seen and not p.dup:
+            oracle["violations"].append(
+                f"round {rnd}: duplicate of {payload!r} without DUP")
+        seen.append(bool(p.dup))
+
+    async def _drain_forever(client) -> None:
+        try:
+            while True:
+                _record(await client.publishes.get())
+        except asyncio.CancelledError:
+            pass
+
+    drainer = asyncio.ensure_future(_drain_forever(sub))
+    killed = asyncio.Event()
+
+    async def _killer(after_s: float) -> None:
+        await asyncio.sleep(after_s)
+        broker.kill()
+        killed.set()
+
+    # the kill lands somewhere inside the publish stream (the publisher
+    # paces itself on acks, so wall time tracks message progress). Torn
+    # rounds kill on the wedge instead — the first post-arm publish times
+    # out against the wedged journal, and THAT is the crash moment
+    kill_task = None
+    if not torn:
+        kill_task = asyncio.ensure_future(
+            _killer(rng.uniform(0.15, 0.15 + msgs * 0.012)))
+    sent_before_death = 0
+    torn_armed = False
+    try:
+        for i in range(msgs):
+            if i >= (msgs * 2) // 3 and sub.auto_ack:
+                # the tail of the stream dies UNACKED at the subscriber:
+                # the broker acks the publisher (journaled pending) but no
+                # subscriber ack ever lands, so the kill strands a real
+                # inflight window — recovery's pending replay
+                # (recovered.inflight → DUP=1 redelivery) is exercised,
+                # not just the retained/session paths
+                sub.auto_ack = False
+            if torn and not torn_armed and i >= msgs // 2:
+                # arm the torn write over the live API: the NEXT group
+                # commit truncates its tail record and wedges the journal
+                # — every later publish must go un-acked
+                try:
+                    await broker.api("/api/v1/failpoints", "PUT",
+                                     {"storage.torn_write":
+                                      "times(1, error)"})
+                    torn_armed = True
+                except Exception:
+                    break  # broker already dead
+            payload = f"r{rnd}-{i}"
+            if retain_every and i % retain_every == retain_every - 1:
+                topic = f"keep/{i % 4}"
+                try:
+                    await asyncio.wait_for(
+                        pub.publish(topic, payload.encode(), qos=1,
+                                    retain=True), 3.0)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    # maybe-applied window: the set may have committed
+                    # with only its PUBACK lost to the kill — the oracle
+                    # accepts EITHER value for this topic this round
+                    oracle["retained_maybe"].setdefault(
+                        topic, set()).add(payload)
+                    break
+                oracle["retained"][topic] = payload
+                oracle["retained_maybe"].pop(topic, None)
+            else:
+                qos = 2 if qos2_every and i % qos2_every == 0 else 1
+                try:
+                    await asyncio.wait_for(
+                        pub.publish(f"t/{i % 5}", payload.encode(),
+                                    qos=qos), 3.0)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    break  # killed mid-publish (or wedged) — not acked
+                acked.add(payload)
+            sent_before_death = i + 1
+        if not killed.is_set():
+            if kill_task is not None:
+                await killed.wait()  # traffic outran the timer
+            else:
+                broker.kill()  # torn round: the wedge is the crash
+                killed.set()
+    finally:
+        if kill_task is not None:
+            kill_task.cancel()
+            await asyncio.gather(kill_task, return_exceptions=True)
+        if not killed.is_set():
+            broker.kill()
+            killed.set()
+        drainer.cancel()
+        await asyncio.gather(drainer, return_exceptions=True)
+        await sub.close()
+        await pub.close()
+
+    # ---- restart on the same workdir/db; recovery runs before listen
+    await broker.start()
+    dur = await broker.api("/api/v1/durability")
+    # ---- the durable subscriber returns; unacked QoS1/2 re-deliver DUP=1
+    sub = await MiniClient.connect(broker.port, "tortoise",
+                                   clean_start=False)
+    await sub.subscribe("t/#", qos=2)
+    deadline = time.monotonic() + 30.0
+    missing = set(acked) - set(received)
+    while missing and time.monotonic() < deadline:
+        try:
+            p = await asyncio.wait_for(
+                sub.publishes.get(), max(0.1, deadline - time.monotonic()))
+        except asyncio.TimeoutError:
+            break
+        _record(p)
+        missing = set(acked) - set(received)
+    await sub.close()
+
+    # ---- retained oracle: a fresh subscriber's replay IS the store
+    verifier = await MiniClient.connect(broker.port, f"torture-rv-{rnd}")
+    await verifier.subscribe("keep/#", qos=0)
+    got_retained: Dict[str, str] = {}
+    quiet_until = time.monotonic() + 2.0
+    while time.monotonic() < quiet_until:
+        try:
+            p = await asyncio.wait_for(verifier.publishes.get(), 0.5)
+        except asyncio.TimeoutError:
+            break
+        if p.retain:
+            got_retained[p.topic] = p.payload.decode()
+            quiet_until = time.monotonic() + 0.5
+    await verifier.close()
+
+    recovery_ms = float(dur.get("recovery_ms") or 0.0)
+    retained_ok = _retained_matches(oracle, got_retained)
+    ok = (not missing and not oracle["violations"] and retained_ok
+          and recovery_ms <= recovery_bound_ms)
+    return {
+        "ok": ok,
+        "round": rnd,
+        "torn": torn,
+        "sent_before_death": sent_before_death,
+        "acked_total": len(acked),
+        "missing_acked": sorted(missing),
+        "dup_violations": list(oracle["violations"]),
+        "retained_expected": len(oracle["retained"]),
+        "retained_got": len(got_retained),
+        "retained_ok": retained_ok,
+        "recovered": dur.get("recovered", {}),
+        "recovery_ms": recovery_ms,
+    }
+
+
+async def run_crash_rounds(workdir: str, *, rounds: int = 5,
+                           msgs: int = 60, torn_every: int = 3,
+                           seed: int = 20260804,
+                           recovery_bound_ms: float = 30000.0,
+                           profile: "Optional[Profile]" = None) -> dict:
+    """N crash-torture rounds against one broker/journal (state carries
+    across kills — that is the point). Every ``torn_every``-th round arms
+    the torn-write failpoint. Returns a verdict dict with per-round rows;
+    ``ok`` iff every invariant held in every round."""
+    import random
+
+    rng = random.Random(seed)
+    prof = profile or PROFILES["crash_restart"]
+    broker = ScenarioBroker(prof, workdir)
+    oracle: Dict[str, Any] = {"acked": set(), "received": {},
+                              "retained": {}, "retained_maybe": {},
+                              "violations": []}
+    rows = []
+    await broker.start()
+    try:
+        for rnd in range(rounds):
+            torn = bool(torn_every) and rnd % torn_every == torn_every - 1
+            row = await crash_torture_round(
+                broker, oracle, rnd=rnd, rng=rng, msgs=msgs, torn=torn,
+                recovery_bound_ms=recovery_bound_ms)
+            rows.append(row)
+    finally:
+        await broker.stop()
+    return {
+        "ok": all(r["ok"] for r in rows) and len(rows) == rounds,
+        "rounds": rows,
+        "acked_total": len(oracle["acked"]),
+        "retained_topics": len(oracle["retained"]),
+        "dup_violations": oracle["violations"],
+    }
+
+
+async def run_crash_restart(profile: "Profile", inproc: bool = False,
+                            workdir: Optional[str] = None) -> dict:
+    """Scenario-matrix runner for the ``crash_restart`` profile: the
+    kill-9 torture loop wrapped in the shared ScenarioReport schema."""
+    if inproc:
+        raise ValueError("crash_restart needs a real process to SIGKILL")
+    report = base_report(profile.name, "subprocess")
+    report["descr"] = profile.descr
+    with tempfile.TemporaryDirectory() as td:
+        wd = workdir or td
+        t0 = time.monotonic()
+        verdict = await run_crash_rounds(wd, rounds=3, msgs=48)
+        seconds = round(time.monotonic() - t0, 3)
+    for row in verdict["rounds"]:
+        report["phases"].append({
+            "name": f"crash_round_{row['round']}"
+                    + ("_torn" if row["torn"] else ""),
+            **row})
+    report["goodput"] = {
+        "published": verdict["acked_total"],
+        "delivered": verdict["acked_total"],
+        "phase_seconds": seconds,
+        "delivered_per_s": round(verdict["acked_total"] / seconds, 1)
+        if seconds else 0.0,
+    }
+    report["crash_torture"] = {k: v for k, v in verdict.items()
+                               if k != "rounds"}
+    return finish_report(report, verdict["ok"])
+
+
+_profile(Profile(
+    name="crash_restart",
+    descr="kill-9 torture against the durability plane: live QoS1/2 + "
+          "retained traffic, SIGKILL inside the commit window (torn-write "
+          "rounds included), restart, verify zero acked loss / DUP-flagged "
+          "duplicates / retained oracle equality / bounded recovery",
+    steps=(),
+    extra_toml=_DURABILITY_TOML,
+    subprocess_only=True,
+    runner=run_crash_restart,
 ))
 
 

@@ -9,6 +9,7 @@ connection handler receives — the extension-manager seam
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -20,6 +21,11 @@ from rmqtt_tpu.broker.metrics import Metrics, Stats
 from rmqtt_tpu.broker.retain import RetainStore
 from rmqtt_tpu.broker.routing import RoutingService
 from rmqtt_tpu.router.base import Router
+
+#: period of the shared store expire-sweep task (ServerContext.start):
+#: TTL'd rows are reaped for EVERY registered store — previously only the
+#: message-storage plugin's flush loop swept, and only its own store
+STORE_SWEEP_INTERVAL_S = 60.0
 
 
 @dataclass
@@ -208,6 +214,25 @@ class BrokerConfig:
     failover_cooldown: float = 1.0  # first probe delay (exp backoff after)
     failover_max_cooldown: float = 30.0
     failover_k_successes: int = 3  # consecutive canary passes to switch back
+    # crash-safe durability plane (broker/durability.py, [durability] conf
+    # section): group-committed write-ahead journal of retained / session /
+    # subscription / QoS1-2 pending state over a SqliteStore (or redis via
+    # durability_storage), replayed into the live broker at boot before
+    # listeners accept. Default OFF — enable=false constructs nothing and
+    # is pinned to byte-for-byte zero behavior change.
+    durability_enable: bool = False
+    durability_path: str = "./data/durability.db"
+    durability_storage: str = ""  # redis://... selects the RESP backend
+    # group-commit window: acks wait at most this long for the batched
+    # fsync; flush_max forces an early commit under burst load
+    durability_flush_interval_ms: float = 5.0
+    durability_flush_max: int = 512
+    # journal rows past the last snapshot before compaction folds them in
+    durability_compact_min: int = 4096
+    # sqlite PRAGMA synchronous for the journal db: "full" = fsync per
+    # group commit (the durability contract), "normal" trades crash
+    # windows for throughput (redis durability is appendfsync policy)
+    durability_sync: str = "full"
     # [failpoints] conf section (utils/failpoints.py): site name → action
     # spec ("off | error | delay(ms) | hang | prob(p, act) | times(n, act)");
     # RMQTT_FAILPOINTS env entries override these at context construction
@@ -310,6 +335,43 @@ class ServerContext:
         # plugin installs itself here; None = storage disabled (the
         # reference's DefaultMessageManager no-op, message.rs:148-164)
         self.message_mgr = None
+        # TTL'd stores registered for the shared expire-sweep task (started
+        # in start()): any subsystem holding a SqliteStore/RedisStore adds
+        # itself here so expired rows are reaped whether or not the
+        # message-storage plugin (whose flush loop used to own the sweep)
+        # happens to be configured
+        self._stores: List[Any] = []
+        self._store_sweep_task = None
+        # crash-safe durability plane (broker/durability.py): None when
+        # disabled — every hot-path guard is one attribute test, the
+        # pinned zero-behavior-change contract
+        self.durability = None
+        if self.cfg.durability_enable:
+            if self.cfg.fabric_enable:
+                # one journal file cannot serve several worker processes:
+                # concurrent recovery would duplicate every persistent
+                # session per worker and concurrent appends share one seq
+                # space (upserts silently overwrite each other's records)
+                raise ValueError(
+                    "[durability] cannot combine with [fabric] workers: "
+                    "each process would recover and journal into the same "
+                    "store (run durability on a single-process broker)")
+            from rmqtt_tpu.broker.durability import DurabilityService
+
+            self.durability = DurabilityService(self, self.cfg)
+            # retained set/clear journals through the same on_set chain the
+            # retainer plugin and cluster broadcast ride (chained, so all
+            # three coexist); durability registers FIRST so later links
+            # (cluster push) see an already-journaled mutation
+            _prev_on_set = self.retain.on_set
+            _dur = self.durability
+
+            def _durable_on_set(topic, msg, _prev=_prev_on_set, _d=_dur):
+                _d.on_retain(topic, msg)
+                if _prev is not None:
+                    _prev(topic, msg)
+
+            self.retain.on_set = _durable_on_set
         # intra-node routing fabric (broker/fabric.py): one router owner per
         # node, workers submit publishes over a UDS mesh. Mutually exclusive
         # with the cluster registries — the fabric IS this node's internal
@@ -450,15 +512,65 @@ class ServerContext:
                 return True
         return False
 
+    # ------------------------------------------------------ store sweeping
+    def add_store(self, store) -> None:
+        """Register a TTL'd store for the periodic expire sweep (plugins
+        and the durability plane call this; idempotent)."""
+        if store not in self._stores:
+            self._stores.append(store)
+
+    def remove_store(self, store) -> None:
+        if store in self._stores:
+            self._stores.remove(store)
+
+    async def sweep_stores_once(self) -> int:
+        """Reap expired rows from every registered store (executor-hopped:
+        network backends must not run socket RTTs on the loop). Returns
+        rows reaped; failures skip to the next store — a dead backend must
+        not starve the others."""
+        import logging as _logging
+
+        loop = asyncio.get_running_loop()
+        reaped = 0
+        for store in list(self._stores):
+            try:
+                reaped += int(await loop.run_in_executor(
+                    None, store.expire_sweep) or 0)
+            except Exception:
+                _logging.getLogger("rmqtt_tpu.broker").warning(
+                    "store expire sweep failed", exc_info=True)
+        if reaped:
+            self.metrics.inc("storage.expired_reaped", reaped)
+        return reaped
+
+    async def _store_sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(STORE_SWEEP_INTERVAL_S)
+            await self.sweep_stores_once()
+
     def start(self) -> None:
         self.routing.start()
         self.delayed.start()
         self.overload.start()
         self.slo.start()
+        if self.durability is not None:
+            self.durability.start()
+        if self._store_sweep_task is None:
+            self._store_sweep_task = asyncio.get_running_loop().create_task(
+                self._store_sweep_loop(), name="store-sweep")
 
     async def stop(self) -> None:
         if self.fabric is not None:
             await self.fabric.stop()
+        if self._store_sweep_task is not None:
+            self._store_sweep_task.cancel()
+            try:
+                await self._store_sweep_task
+            except asyncio.CancelledError:
+                pass
+            self._store_sweep_task = None
+        if self.durability is not None:
+            await self.durability.stop()
         await self.slo.stop()
         await self.overload.stop()
         await self.routing.stop()
@@ -538,6 +650,21 @@ class ServerContext:
                     (hbm() or {}).get("total_bytes", 0) / 2**20, 3)
             except Exception:
                 pass
+        # durability-plane gauges (broker/durability.py): journal health +
+        # what the last cold-start recovery replayed; zeros while disabled
+        dur = self.durability
+        if dur is not None:
+            s.durability_enabled = 1
+            s.durability_journal_len = max(
+                0, dur._committed - dur._snapshot_seq)
+            s.durability_appends = dur.appends
+            s.durability_commits = dur.commits
+            s.durability_compactions = dur.compactions
+            s.durability_recovered_retained = dur.recovered["retained"]
+            s.durability_recovered_sessions = dur.recovered["sessions"]
+            s.durability_recovered_subs = dur.recovered["subs"]
+            s.durability_recovered_inflight = dur.recovered["inflight"]
+            s.durability_recovery_ms = dur.recovery_ms
         # process RSS (utils/sysmon.py — same probe the overload sampler
         # uses); sums to a cluster memory total in /stats/sum
         from rmqtt_tpu.utils.sysmon import rss_mb

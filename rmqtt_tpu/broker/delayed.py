@@ -47,10 +47,15 @@ class DelayedSender:
     ) -> None:
         self._forward = forward
         self.max_pending = max_pending
-        self._heap: List[Tuple[float, int, Message]] = []
+        self._heap: List[Tuple[float, int, Message, int]] = []
         self._seq = itertools.count()
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        # durability seam (broker/durability.py): called as on_fired(did)
+        # AFTER a journaled entry's forward completes, resolving its
+        # durable record (a crash in between replays the fire — the
+        # delayed path is at-least-once across kill -9, like QoS1)
+        self.on_fired: Optional[Callable[[int], None]] = None
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -68,11 +73,15 @@ class DelayedSender:
                 pass
             self._task = None
 
-    def push(self, delay_secs: int, msg: Message) -> bool:
-        """Schedule; False if the pending cap is hit (message dropped)."""
+    def push(self, delay_secs: float, msg: Message, did: int = 0) -> bool:
+        """Schedule; False if the pending cap is hit (message dropped).
+        ``did`` is the durable journal id riding a journaled entry (0 =
+        not journaled); it feeds ``on_fired`` after delivery."""
         if len(self._heap) >= self.max_pending:
             return False
-        heapq.heappush(self._heap, (time.monotonic() + delay_secs, next(self._seq), msg))
+        heapq.heappush(
+            self._heap,
+            (time.monotonic() + delay_secs, next(self._seq), msg, did))
         self._wake.set()
         return True
 
@@ -81,7 +90,7 @@ class DelayedSender:
             if not self._heap:
                 self._wake.clear()
                 await self._wake.wait()
-            due, _, msg = self._heap[0]
+            due, _, msg, did = self._heap[0]
             delay = due - time.monotonic()
             if delay > 0:
                 try:
@@ -93,3 +102,7 @@ class DelayedSender:
             heapq.heappop(self._heap)
             if not msg.is_expired():
                 await self._forward(msg)
+            if did and self.on_fired is not None:
+                # resolve the durable record only after the forward (whose
+                # own enq records precede this in the journal) completed
+                self.on_fired(did)

@@ -234,6 +234,7 @@ class HttpApi:
                 "/api/v1/slo", "/api/v1/slo/sum",
                 "/api/v1/device", "/api/v1/device/sum",
                 "/api/v1/overload", "/api/v1/fabric",
+                "/api/v1/durability",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
                 "/api/v1/traces", "/api/v1/traces/slow",
                 "/api/v1/traces/{trace_id}",
@@ -457,6 +458,14 @@ class HttpApi:
             # state + signals, admission counters, shed totals, breakers;
             # shape-stable when the subsystem is disabled
             return 200, {"node": ctx.node_id, **ctx.overload.snapshot()}, J
+        if path == "/api/v1/durability":
+            # durability plane (broker/durability.py): journal health,
+            # group-commit counters, last recovery's replay counts and the
+            # retained digest (the crash-torture oracle's comparison
+            # point); shape-stable {"enabled": false} while disabled
+            d = ctx.durability
+            body_out = d.snapshot() if d is not None else {"enabled": False}
+            return 200, {"node": ctx.node_id, **body_out}, J
         if path == "/api/v1/failpoints":
             # fault-injection registry (utils/failpoints.py). GET lists every
             # site's action + trigger counters; PUT reconfigures sites live
@@ -719,6 +728,10 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "fabric_plan_hits","directory_epoch",
  "routing_stage_fabric_submit_ms_total",
  "routing_stage_fabric_fanout_ms_total",
+ "durability_journal_len","durability_appends","durability_commits",
+ "durability_compactions","durability_recovered_retained",
+ "durability_recovered_sessions","durability_recovered_subs",
+ "durability_recovered_inflight","durability_recovery_ms",
  "device_jit_traces","device_jit_cache_hits","device_retrace_storms",
  "device_hbm_modeled_mb","routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",

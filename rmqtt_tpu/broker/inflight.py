@@ -43,6 +43,9 @@ class OutEntry:
     # the PUBACK/PUBCOMP arrives in the read loop, a different task from
     # the fan-out, so the context must travel with the inflight entry
     trace: object = None
+    # durable pending id (broker/durability.py DeliverItem.did): the ack
+    # journals against it; 0 = this delivery is not journaled
+    did: int = 0
 
 
 class OutInflight:

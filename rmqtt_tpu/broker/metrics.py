@@ -150,6 +150,22 @@ class Stats:
         self.directory_epoch = 0
         self.routing_stage_fabric_submit_ms_total = 0.0
         self.routing_stage_fabric_fanout_ms_total = 0.0
+        # durability-plane gauges (broker/durability.py), filled by
+        # ServerContext.stats(); zeros while [durability] is disabled so
+        # the observability surface stays shape-stable. journal_len counts
+        # committed rows past the last snapshot; the recovered_* gauges
+        # report what the last cold-start recovery replayed and
+        # recovery_ms (avg-mode, like every `_ms` gauge) how long it took
+        self.durability_enabled = 0
+        self.durability_journal_len = 0
+        self.durability_appends = 0
+        self.durability_commits = 0
+        self.durability_compactions = 0
+        self.durability_recovered_retained = 0
+        self.durability_recovered_sessions = 0
+        self.durability_recovered_subs = 0
+        self.durability_recovered_inflight = 0
+        self.durability_recovery_ms = 0.0
         # cluster membership + partition-healing gauges
         # (cluster/membership.py), filled by ServerContext.stats(); zeros
         # on single-node brokers so the surface stays shape-stable.

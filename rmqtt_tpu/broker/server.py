@@ -128,6 +128,14 @@ class MqttBroker:
             # client listeners accept (a CONNECT may need the directory)
             await self.ctx.fabric.start()
         await self.ctx.plugins.start_all()
+        if self.ctx.durability is not None:
+            # cold-start recovery (broker/durability.py) BEFORE any
+            # listener accepts — mirroring the fabric warm-up gate: a
+            # CONNECT must never race a half-replayed session/retained
+            # store. Runs after plugin start so retainer-loaded retained
+            # rows (possibly staler) are superseded; the session-storage
+            # plugin refuses to coexist (one owner of session durability).
+            await self.ctx.durability.recover()
         cfg = self.ctx.cfg
         rp = {"reuse_port": True} if cfg.reuse_port else {}
         self._server = await asyncio.start_server(
@@ -714,6 +722,17 @@ def _supervise_workers(args, argv: list) -> None:
         sys.exit("--workers manages node ids and the cluster itself; it "
                  "cannot combine with --cluster-mode/--cluster-listen/"
                  "--node-id/--peer")
+    if args.config:
+        from rmqtt_tpu import conf
+
+        if conf.load(args.config).broker.durability_enable:
+            # every worker would recover + journal into ONE store file:
+            # duplicated sessions per process and colliding journal seqs
+            # (upserts overwrite each other). Same class of guard as
+            # fabric+cluster — fail at launch, not at the first kill -9.
+            sys.exit("[durability] cannot combine with --workers: each "
+                     "worker process would recover and journal into the "
+                     "same store (run the durability plane single-process)")
     fabric_dir = None
     fabric_tmp = None
     fabric_on = args.fabric or args.fabric_dir

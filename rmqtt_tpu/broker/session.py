@@ -61,6 +61,11 @@ class DeliverItem:
     topic_filter: str
     sub_ids: Tuple[int, ...] = ()
     dup: bool = False
+    # durable id (broker/durability.py): the journal seq of this QoS1/2
+    # delivery's pending record; 0 = not journaled (durability off, QoS0,
+    # or a non-persistent session). Rides into the OutEntry so the
+    # subscriber's PUBACK/PUBCOMP can journal the matching ack.
+    did: int = 0
     # encoded-frame cache SHARED across one publish's fan-out (the fan-out
     # loop passes one dict per message): QoS0 subscribers on the same
     # protocol version reuse identical wire bytes instead of re-encoding
@@ -159,10 +164,23 @@ class Session:
                 self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, self.id, item.msg, "shed-qos0")
             )
             return
+        # durability plane (broker/durability.py): a QoS1/2 delivery bound
+        # for a persistent session journals as pending BEFORE it can be
+        # acknowledged anywhere — the publisher's PUBACK barrier then rides
+        # the group commit. did != 0 marks an already-journaled item
+        # (recovery re-enqueue), which must not double-journal.
+        dur = self.ctx.durability
+        if (dur is not None and item.qos > 0 and item.did == 0
+                and self.limits.session_expiry > 0):
+            item.did = dur.on_enqueue(self.client_id, item)
         policy = Policy.DROP_CURRENT if item.qos == 0 and self.connected else Policy.DROP_EARLY
         dropped = self.deliver_queue.push(item, policy)
         if dropped is not None:
             self.ctx.metrics.drop("queue_full")
+            if dur is not None and dropped.did:
+                # a terminal drop resolves the pending record, or recovery
+                # would resurrect a message the broker chose to shed
+                dur.on_ack(self.client_id, dropped.did)
             asyncio.get_running_loop().create_task(
                 self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, self.id, dropped.msg, "queue-full")
             )
@@ -176,6 +194,11 @@ class Session:
         """Socket gone: schedule will + expiry (session.rs:405-494)."""
         self.connected = False
         self.state = None
+        # durability: anchor the expiry countdown so a broker restart
+        # resumes the remaining window instead of a fresh one
+        dur = self.ctx.durability
+        if dur is not None and self.limits.session_expiry > 0:
+            dur.on_session_offline(self.client_id)
         if len(self.out_inflight) and self.limits.session_expiry > 0 and not kicked:
             # unacked QoS1/2 carried into the GENUINE offline path only
             # (hook.rs OfflineInflightMessages; session.rs:277-291): a
@@ -241,7 +264,7 @@ class Session:
             items.append(
                 DeliverItem(
                     msg=e.msg, qos=e.qos, retain=e.retain, topic_filter="",
-                    sub_ids=e.subscription_ids, dup=True,
+                    sub_ids=e.subscription_ids, dup=True, did=e.did,
                 )
             )
         q = self.deliver_queue.drain()
@@ -295,7 +318,11 @@ async def restore_session(ctx, snap: dict, node_id: Optional[int] = None) -> Opt
     """Rebuild an OFFLINE session from a snapshot (offline_restart,
     session.rs:516-558): re-registers subscriptions (under ``node_id`` if
     given — the takeover-transfer case re-homes them) and refills the queue.
-    Returns None if the snapshot already expired."""
+    Returns None if the snapshot already expired.
+
+    NOTE: broker/durability.py `_restore_sessions` mirrors this for the
+    journal-shaped durable state (plus per-item durable ids) — semantic
+    fixes here (expiry math, fencing) must propagate there."""
     from rmqtt_tpu.cluster.messages import msg_from_wire, opts_from_wire
     from rmqtt_tpu.core.topic import strip_prefixes
 
@@ -505,6 +532,8 @@ class SessionState:
         if expired:
             self.ctx.metrics.inc("messages.expired")
             self.ctx.metrics.drop("expired")
+            if item.did and self.ctx.durability is not None:
+                self.ctx.durability.on_ack(s.client_id, item.did)
             await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "expired")
             return
         props: Dict[int, object] = {
@@ -522,13 +551,15 @@ class SessionState:
         if item.qos > 0:
             packet_id = s.out_inflight.alloc_packet_id()
             if packet_id is None:
+                if item.did and self.ctx.durability is not None:
+                    self.ctx.durability.on_ack(s.client_id, item.did)
                 await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "no-packet-id")
                 return
             s.out_inflight.push(
                 OutEntry(
                     packet_id, msg, item.qos, subscription_ids=item.sub_ids,
                     retain=item.retain, wire_props=dict(props),
-                    trace=item.trace,
+                    trace=item.trace, did=item.did,
                 )
             )
         # QoS0 fan-out fast path: for subscribers of the same protocol
@@ -597,6 +628,10 @@ class SessionState:
             for e in s.out_inflight.due():
                 if not s.out_inflight.mark_retry(e):
                     self.ctx.metrics.drop("retries_exhausted")
+                    if e.did and self.ctx.durability is not None:
+                        # terminal: the broker gave up on this delivery —
+                        # recovery must not resurrect it
+                        self.ctx.durability.on_ack(s.client_id, e.did)
                     await self.ctx.hooks.fire(
                         HookType.MESSAGE_DROPPED, s.id, e.msg, "retries-exhausted"
                     )
@@ -644,6 +679,8 @@ class SessionState:
             e = s.out_inflight.ack(p.packet_id)
             if e is not None:
                 self._record_ack_rtt(e)
+                if e.did and self.ctx.durability is not None:
+                    self.ctx.durability.on_ack(s.client_id, e.did)
                 await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
         elif isinstance(p, pk.Pubrec):
             e = s.out_inflight.pubrec(p.packet_id)
@@ -655,9 +692,20 @@ class SessionState:
             e = s.out_inflight.ack(p.packet_id)
             if e is not None:
                 self._record_ack_rtt(e)
+                if e.did and self.ctx.durability is not None:
+                    self.ctx.durability.on_ack(s.client_id, e.did)
                 await self.ctx.hooks.fire(HookType.MESSAGE_ACKED, s.id, e.msg, None)
         elif isinstance(p, pk.Pubrel):
-            s.in_qos2.remove(p.packet_id)
+            removed = s.in_qos2.remove(p.packet_id)
+            dur = self.ctx.durability
+            if (removed and dur is not None
+                    and s.limits.session_expiry > 0):
+                dur.on_qos2_release(s.client_id, p.packet_id)
+                if dur.dirty:
+                    # PUBCOMP is the client's license to REUSE this packet
+                    # id: the release must be durable first, or a restored
+                    # stale window entry would swallow a future publish
+                    await dur.barrier()
             await self.send(pk.Pubcomp(p.packet_id))
         elif isinstance(p, pk.Subscribe):
             await self._on_subscribe(p)
@@ -788,12 +836,38 @@ class SessionState:
 
                 await self.send(pk.Pubrec(p.packet_id, RC_RECEIVE_MAX_EXCEEDED))
                 return
+            # durability: a persistent publisher's dedup-window entry is
+            # journaled BEFORE the fan-out's own pending records — a
+            # timer-driven commit landing mid-publish must never persist
+            # the fan-out without the window entry, or a post-crash DUP
+            # resend would fan out a second time (dup=False) on top of
+            # the recovered redelivery. A refusal resolves it below.
+            dur = self.ctx.durability
+            if dur is not None and s.limits.session_expiry > 0:
+                dur.on_qos2_open(s.client_id, p.packet_id)
         accepted, reason = await self._publish(p)
+        if p.qos == 2 and not accepted:
+            # refused: clear the dedup entry — in memory AND in the
+            # journal (before the barrier), so a restored stale entry can
+            # never swallow a future publish reusing this packet id
+            s.in_qos2.remove(p.packet_id)
+            dur = self.ctx.durability
+            if dur is not None and s.limits.session_expiry > 0:
+                dur.on_qos2_release(s.client_id, p.packet_id)
+        # durability ack barrier (broker/durability.py): everything this
+        # publish journaled (retained set, per-subscriber pending records,
+        # the QoS2 window entry) must be group-committed BEFORE the
+        # publisher sees PUBACK/PUBREC — the zero-acked-loss contract
+        # across kill -9. Amortized: every concurrent publisher shares one
+        # commit; no-op when nothing is buffered. QoS0 has no ack and
+        # rides the flush window instead.
+        if p.qos > 0:
+            dur = self.ctx.durability
+            if dur is not None and dur.dirty:
+                await dur.barrier()
         if p.qos == 1:
             await self.send(pk.Puback(p.packet_id, reason if self.codec.version == pk.V5 else 0))
         elif p.qos == 2:
-            if not accepted:
-                s.in_qos2.remove(p.packet_id)
             await self.send(pk.Pubrec(p.packet_id, reason if self.codec.version == pk.V5 else 0))
 
     async def _publish(self, p: pk.Publish) -> Tuple[bool, int]:
@@ -867,7 +941,14 @@ class SessionState:
                 self.ctx.metrics.inc("retain.refused")
         if delay_secs is not None:
             stripped = replace(msg, retain=False)
-            if not self.ctx.delayed.push(delay_secs, stripped):
+            # durability: the PUBACK of a $delayed publish rides the same
+            # barrier as everything else, so an acked delayed message
+            # survives kill -9 and re-arms with its remaining delay
+            dur = self.ctx.durability
+            did = dur.on_delayed(delay_secs, stripped) if dur is not None else 0
+            if not self.ctx.delayed.push(delay_secs, stripped, did=did):
+                if did:
+                    dur.on_delayed_done(did)  # refused: resolve the record
                 await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "delayed-cap")
                 return False, RC_UNSPECIFIED_ERROR
             return True, RC_SUCCESS
@@ -899,6 +980,11 @@ class SessionState:
             if self.codec.version != pk.V5 and code >= 0x80:
                 code = 0x80  # v3.1.1 SUBACK only knows 0x80 for failure
             codes.append(code)
+        # durability: a SUBACKed subscription must survive kill -9 — wait
+        # for the journaled sub records' group commit (no-op when clean)
+        dur = self.ctx.durability
+        if dur is not None and dur.dirty:
+            await dur.barrier()
         await self.send(pk.Suback(p.packet_id, codes))
 
     async def _subscribe_one(self, topic_filter: str, opts: pk.SubOpts, sub_id) -> int:
@@ -1006,4 +1092,7 @@ class SessionState:
             if ok:
                 await self.ctx.hooks.fire(HookType.SESSION_UNSUBSCRIBED, s.id, tf, None)
             codes.append(RC_SUCCESS if ok else 0x11)  # 0x11 = no subscription existed
+        dur = self.ctx.durability
+        if dur is not None and dur.dirty:
+            await dur.barrier()
         await self.send(pk.Unsuback(p.packet_id, codes))

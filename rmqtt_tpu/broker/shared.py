@@ -91,11 +91,21 @@ class SessionRegistry:
                 # a resume is a change of ownership too: re-fence so a
                 # concurrent owner elsewhere loses the heal-time conflict
                 existing.fence = self.next_fence()
+                if (ctx.durability is not None
+                        and existing.limits.session_expiry > 0):
+                    # back online: clear the expiry-countdown anchor and
+                    # persist the resume's re-fence
+                    ctx.durability.on_session_online(
+                        existing.client_id, existing.fence)
                 return existing, True
             await self.terminate(existing, "takeover-clean")
         session = Session(ctx, id, connect_info, limits, clean_start)
         session.fence = self.next_fence()
         self._sessions[id.client_id] = session
+        # durability plane (broker/durability.py): persistent sessions
+        # journal their creation so a kill -9 rebuilds them at boot
+        if ctx.durability is not None:
+            ctx.durability.on_session_created(session)
         await ctx.hooks.fire(HookType.SESSION_CREATED, id, None, None)
         return session, False
 
@@ -130,6 +140,9 @@ class SessionRegistry:
         if items:
             await self.router_remove_many(items)
         session.subscriptions.clear()
+        if (self.ctx.durability is not None
+                and session.limits.session_expiry > 0):
+            self.ctx.durability.on_session_terminated(session.client_id)
         await self.ctx.hooks.fire(HookType.SESSION_TERMINATED, session.id, reason, None)
 
     # ------------------------------------------------------------ sub/unsub
@@ -150,6 +163,13 @@ class SessionRegistry:
             raise SubscriptionLimitExceeded(stripped)
         await self.router_add(stripped, session.id, opts)
         session.subscriptions[full_filter] = opts
+        # durability: subscriptions of persistent sessions journal through
+        # the registry chokepoint, so every mode (live SUBSCRIBE, HTTP API,
+        # auto-subscription, cluster restore) is covered alike
+        if (self.ctx.durability is not None
+                and session.limits.session_expiry > 0):
+            self.ctx.durability.on_subscribe(
+                session.client_id, full_filter, opts)
 
     async def router_add(self, stripped: str, id, opts) -> None:
         self.ctx.router.add(stripped, id, opts)
@@ -173,6 +193,9 @@ class SessionRegistry:
         except Exception:
             stripped = full_filter
         await self.router_remove(stripped, session.id)
+        if (self.ctx.durability is not None
+                and session.limits.session_expiry > 0):
+            self.ctx.durability.on_unsubscribe(session.client_id, full_filter)
         return True
 
     async def retain_load_with(self, topic_filter: str):

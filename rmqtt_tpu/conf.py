@@ -345,6 +345,18 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "submit_deadline_s": ("fabric_submit_deadline_s", float),
         "warm_grace_s": ("fabric_warm_grace_s", float),
     }, broker_kwargs)
+    # [durability] — crash-safe durability plane (broker/durability.py):
+    # group-committed journal of retained/session/subscription/inflight
+    # state + cold-start recovery. Default off (zero behavior change).
+    _apply_section(tree, "durability", {
+        "enable": ("durability_enable", bool),
+        "path": ("durability_path", str),
+        "storage": ("durability_storage", str),
+        "flush_interval_ms": ("durability_flush_interval_ms", float),
+        "flush_max": ("durability_flush_max", int),
+        "compact_min": ("durability_compact_min", int),
+        "sync": ("durability_sync", str),
+    }, broker_kwargs)
     # [failpoints] — fault-injection sites (utils/failpoints.py): quoted
     # site name → action spec. Validated at load (unknown sites / bad specs
     # raise when ServerContext applies them); listed here as a free-form

@@ -189,6 +189,11 @@ class MessageStoragePlugin(Plugin):
     async def init(self) -> None:
         hooks = self.ctx.hooks
         self.ctx.message_mgr = self
+        # TTL'd rows/marks are reaped by the ServerContext-wide store
+        # sweep task (previously this plugin's flush loop swept, and ONLY
+        # its own store — a retainer/session store without this plugin
+        # loaded never got reaped)
+        self.ctx.add_store(self.store)
 
         async def on_publish(_ht, args, prev):
             msg = prev if prev is not None else args[1]
@@ -255,17 +260,15 @@ class MessageStoragePlugin(Plugin):
 
         async def flush_loop():
             loop = asyncio.get_running_loop()
-            tick = 0
             while True:
                 await asyncio.sleep(0.5)
-                tick += 1
                 try:
                     if self._net:
                         await loop.run_in_executor(None, self.flush_forwarded)
                     else:
                         self.flush_forwarded()
-                    if tick % 120 == 0:  # ~60s: reclaim expired rows/marks
-                        await loop.run_in_executor(None, self.store.expire_sweep)
+                    # expired rows/marks are reaped by the context-wide
+                    # store sweep (ServerContext.sweep_stores_once)
                 except Exception:  # failed marks re-buffer; retry next tick
                     pass
 
@@ -280,6 +283,7 @@ class MessageStoragePlugin(Plugin):
             self._flush_task = None
         if getattr(self.ctx, "message_mgr", None) is self:
             self.ctx.message_mgr = None
+        self.ctx.remove_store(self.store)
         try:
             self.flush_forwarded()
         finally:

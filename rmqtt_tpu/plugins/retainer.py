@@ -47,6 +47,8 @@ class RetainerPlugin(Plugin):
 
     async def start(self) -> None:
         retain = self.ctx.retain
+        # expired rows are reaped by the context-wide store sweep
+        self.ctx.add_store(self.store)
         # load persisted retains
         for topic, mw in self.store.scan(NS):
             msg = msg_from_wire(mw)
@@ -68,6 +70,7 @@ class RetainerPlugin(Plugin):
         self.ctx.retain.on_set = self._prev_on_set
         if self._wb is not None:
             self._wb.shutdown(wait=True)  # drain pending write-behinds
+        self.ctx.remove_store(self.store)
         self.store.close()
         return True
 

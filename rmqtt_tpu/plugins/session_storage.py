@@ -27,6 +27,18 @@ class SessionStoragePlugin(Plugin):
 
     def __init__(self, ctx, config=None) -> None:
         super().__init__(ctx, config)
+        if getattr(ctx, "durability", None) is not None:
+            # two owners of session persistence cannot coexist: this
+            # plugin's boot-time restore would land sessions in the
+            # registry FIRST, making durability recovery skip them — and
+            # silently drop their journaled (publisher-acked) pending
+            # QoS1/2 records. The durability plane subsumes this plugin
+            # (it also persists live inflight state, which the disconnect
+            # hook here never sees), so refuse loudly at load.
+            raise ValueError(
+                "rmqtt-session-storage cannot combine with [durability]: "
+                "the durability plane already persists sessions (and "
+                "their unacked windows) — disable one of the two")
         from rmqtt_tpu.storage import make_store
 
         self.store = make_store(self.config)
@@ -49,6 +61,8 @@ class SessionStoragePlugin(Plugin):
 
     async def init(self) -> None:
         hooks = self.ctx.hooks
+        # expired snapshots are reaped by the context-wide store sweep
+        self.ctx.add_store(self.store)
 
         async def on_disconnected(_ht, args, _prev):
             id = args[0]
@@ -89,6 +103,7 @@ class SessionStoragePlugin(Plugin):
         for un in self._unhooks:
             un()
         self._unhooks = []
+        self.ctx.remove_store(self.store)
         self.store.close()
         return True
 

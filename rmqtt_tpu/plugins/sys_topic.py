@@ -119,6 +119,7 @@ class SysTopicPlugin(Plugin):
                 await self._publish_latency()
                 await self._publish_tracing()
                 await self._publish_device()
+                await self._publish_durability()
             await self._publish_slo()
             await self._publish_overload()
             await self._publish_failover()
@@ -173,6 +174,20 @@ class SysTopicPlugin(Plugin):
         disp["rollups"] = disp.get("rollups", [])[-6:]  # bounded payload
         await self._publish(
             f"{self._prefix}/device/dispatch", json.dumps(disp).encode()
+        )
+
+    async def _publish_durability(self) -> None:
+        """$SYS/brokers/<node>/durability: journal health + the last
+        cold-start recovery's replay counters (broker/durability.py).
+        Published only when the plane is enabled — disabled brokers keep
+        their $SYS tree unchanged (the zero-behavior-change pin)."""
+        dur = getattr(self.ctx, "durability", None)
+        if dur is None:
+            return
+        snap = dur.snapshot()
+        snap.pop("retain_digest", None)  # digest stays on the HTTP API
+        await self._publish(
+            f"{self._prefix}/durability", json.dumps(snap).encode()
         )
 
     async def _publish_slo(self) -> None:

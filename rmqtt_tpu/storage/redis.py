@@ -291,6 +291,16 @@ class RedisStore:
             fp=_FP_WRITE)
         return bool(n)
 
+    def delete_many(self, ns: str, keys) -> int:
+        """Bulk delete in one pipeline (surface parity with sqlite)."""
+        keys = list(keys)
+        if not keys:
+            return 0
+        deleted, _ = self._c.pipeline([
+            ("DEL", *[self._k(ns, k) for k in keys]),
+            ("SREM", self._nsk(ns), *keys)], fp=_FP_WRITE)
+        return int(deleted)
+
     def delete_int_upto(self, ns: str, n: int) -> int:
         """Delete every key whose integer value is <= n (raft log
         compaction: keys are 1-based absolute log indices)."""

@@ -62,6 +62,11 @@ SITES: List[Tuple[str, str]] = [
     ("device.upload", "device-table HBM refresh (delta scatter or full pack+put)"),
     ("storage.write", "sqlite/redis store mutations (put/delete/bulk)"),
     ("storage.read", "sqlite/redis store reads (get/scan/count)"),
+    ("storage.fsync", "durability journal group commit (the batched fsync "
+                      "window; error = commit retried, hang = acks park)"),
+    ("storage.torn_write", "durability journal append (truncates the last "
+                           "record mid-write and wedges the journal — "
+                           "recovery must drop the torn tail by CRC)"),
     ("cluster.forward", "cross-node publish forwarding (broadcast + raft)"),
     ("cluster.rpc", "every cluster frame, both directions (partition: "
                     "outbound fails fast, inbound is blackholed)"),

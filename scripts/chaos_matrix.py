@@ -413,6 +413,68 @@ async def cell_fabric(site: str, action: str) -> dict:
             await b.stop()
 
 
+async def cell_durability_fsync(site: str, action: str) -> dict:
+    """Durability journal group-commit fault: injected fsync errors leave
+    the batch buffered and RETRIED — the publisher's ack is delayed, never
+    lost, and the ack only lands once the commit finally succeeds."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, durability_enable=True,
+            durability_path=f"{td}/durability.db",
+            durability_flush_interval_ms=3.0)))
+        await b.start()
+        fp = FAILPOINTS.point(site)
+        base = fp.triggers
+        try:
+            # persistent subscriber: its pending records are what the
+            # injected fsync failures hold up
+            sub = await TestClient.connect(b.port, "cmd-sub",
+                                           clean_start=False)
+            await sub.subscribe("d/#", qos=1)
+            pub = await TestClient.connect(b.port, "cmd-pub")
+            await pub.publish("d/warm", b"w", qos=1)
+            FAILPOINTS.set(site, action)
+            # the ack barrier rides the retried commit: this publish's
+            # PUBACK must come AFTER the injected failures burn off
+            await pub.publish("d/hit", b"h", qos=1)
+            FAILPOINTS.set(site, "off")
+            await pub.publish("d/after", b"a", qos=1)
+            got = {(await sub.recv(timeout=10.0)).payload for _ in range(3)}
+            d = b.ctx.durability
+            return {"ok": (got == {b"w", b"h", b"a"}
+                           and fp.triggers > base
+                           and d.commit_errors >= 1 and not d.wedged),
+                    "triggers": fp.triggers - base,
+                    "commit_errors": d.commit_errors,
+                    "commits": d.commits}
+        finally:
+            FAILPOINTS.clear_all()
+            await b.stop()
+
+
+async def cell_durability_crash() -> dict:
+    """One fast kill-9 torture round (scripts/crash_torture.py machinery,
+    torn-write armed): SIGKILL a real durability-enabled broker subprocess
+    mid-traffic with a truncated journal tail, restart, verify zero acked
+    loss / DUP-only duplicates / retained-oracle equality."""
+    import tempfile
+
+    from rmqtt_tpu.bench.scenarios import run_crash_rounds
+
+    with tempfile.TemporaryDirectory() as td:
+        verdict = await run_crash_rounds(td, rounds=1, msgs=24,
+                                         torn_every=1)
+    row = verdict["rounds"][0] if verdict["rounds"] else {}
+    return {"ok": verdict["ok"],
+            "acked": row.get("acked_total"),
+            "missing": row.get("missing_acked"),
+            "retained_ok": row.get("retained_ok"),
+            "recovered": row.get("recovered"),
+            "recovery_ms": row.get("recovery_ms")}
+
+
 async def cell_bridge(site: str, action: str) -> dict:
     from rmqtt_tpu.plugins.bridge_mqtt import BridgeEgressMqttPlugin
 
@@ -470,13 +532,18 @@ MATRIX = {
     "cluster.rpc:node_kill": lambda: cell_cluster_node_kill(),
     "bridge.egress:error": lambda: cell_bridge("bridge.egress", "times(1, error)"),
     "fabric.submit:error": lambda: cell_fabric("fabric.submit", "times(1, error)"),
+    "storage.fsync:error": lambda: cell_durability_fsync(
+        "storage.fsync", "times(2, error)"),
+    "storage.torn_write:crash_torture": cell_durability_crash,
 }
 
-#: tier-1 subset (fast, no hang/delay/subprocess cells): run by
-#: tests/test_failpoints.py
+#: tier-1 subset (fast cells — mostly in-proc; the torn-write torture
+#: cell is the one subprocess exception, a single small kill-9 round so
+#: the recovery path can't rot): run by tests/test_failpoints.py
 FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
                "bridge.egress:error", "cluster.rpc:partition",
-               "fabric.submit:error"]
+               "fabric.submit:error", "storage.fsync:error",
+               "storage.torn_write:crash_torture"]
 
 
 async def run_matrix(cells=None) -> dict:

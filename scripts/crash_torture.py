@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Kill-9 torture harness for the durability plane → JSON verdict.
+
+N rounds of live QoS1/2 + retained traffic against a REAL broker
+subprocess running with ``[durability] enable = true``; each round
+SIGKILLs the broker at a randomized point (the 20ms group-commit window
+means kills regularly land inside an open commit; every --torn-every'th
+round additionally arms the ``storage.torn_write`` failpoint over the live
+HTTP API so the journal wedges with a truncated tail record), restarts it
+on the same journal, and verifies the durability invariants against
+client-side oracles:
+
+- zero acked loss: every QoS1/2 publish the broker acknowledged reaches
+  the durable subscriber after the restart;
+- duplicates only with DUP=1;
+- retained equality: a fresh subscriber's retained replay matches the
+  oracle's topic → payload map (maybe-applied PUBACK window honored);
+- bounded recovery time (``durability_recovery_ms``).
+
+State accumulates across rounds on one journal — compaction, snapshot
+folding and repeated torn tails are all exercised by the same run.
+
+Run: ``python scripts/crash_torture.py --rounds 5 [--msgs 60]
+[--torn-every 3] [--seed N] [--out crash_torture.json]``
+Exit code 0 iff every invariant held in every round. A 1-round fast cell
+runs in tier-1 via scripts/chaos_matrix.py (FAST_SUBSET).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.bench.scenarios import run_crash_rounds  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--msgs", type=int, default=60,
+                    help="publishes per round (1 in --torn-every is retained)")
+    ap.add_argument("--torn-every", type=int, default=3,
+                    help="every Nth round arms storage.torn_write "
+                         "(0 = never)")
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--recovery-bound-ms", type=float, default=30000.0)
+    ap.add_argument("--workdir", default=None,
+                    help="reuse a journal dir across invocations "
+                         "(default: a fresh temp dir)")
+    ap.add_argument("--out", default="crash_torture.json")
+    args = ap.parse_args()
+
+    async def run() -> dict:
+        if args.workdir:
+            Path(args.workdir).mkdir(parents=True, exist_ok=True)
+            return await run_crash_rounds(
+                args.workdir, rounds=args.rounds, msgs=args.msgs,
+                torn_every=args.torn_every, seed=args.seed,
+                recovery_bound_ms=args.recovery_bound_ms)
+        with tempfile.TemporaryDirectory(prefix="crash-torture-") as td:
+            return await run_crash_rounds(
+                td, rounds=args.rounds, msgs=args.msgs,
+                torn_every=args.torn_every, seed=args.seed,
+                recovery_bound_ms=args.recovery_bound_ms)
+
+    verdict = asyncio.run(run())
+    for row in verdict["rounds"]:
+        print(f"[{'PASS' if row['ok'] else 'FAIL'}] round {row['round']}"
+              f"{' (torn)' if row['torn'] else ''}: "
+              f"acked={row['acked_total']} "
+              f"missing={len(row['missing_acked'])} "
+              f"retained_ok={row['retained_ok']} "
+              f"recovered={row['recovered']} "
+              f"recovery={row['recovery_ms']}ms", flush=True)
+    Path(args.out).write_text(json.dumps(verdict, indent=2) + "\n")
+    print(f"verdict → {args.out} (ok={verdict['ok']})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
