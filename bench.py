@@ -1413,6 +1413,152 @@ def run_devprof_overhead_config(name, rng, reduced):
     return res
 
 
+def run_hostprof_overhead_config(name, rng, reduced):
+    """Config 14: host-plane profiler overhead (broker/hostprof.py) on the
+    REAL publish path, cfg7-style order-symmetric paired estimator.
+
+    One live broker pipe (real sockets, the deployed RoutingService); the
+    profiler is ARMED (sampler task + gc callbacks + watchdog thread —
+    exactly what ``[observability] host_profile`` enables) for the ON
+    bursts and fully DISARMED for the OFF bursts. HOSTPROF is
+    process-global and the loop is shared, so unlike cfg7 the conditions
+    cannot run as two live brokers — per-burst arm/disarm on one pipe is
+    the honest design (the profiler's cost IS its background wakeups +
+    per-collection gc callback, and those run during the armed bursts).
+    Quads (off,on,on,off) with min-of-two per condition filter one-sided
+    host-load spikes; the median pair ratio bounds the enabled cost at
+    ≤2% of e2e p50 burst time (standalone ``--config 14`` exits 1 past
+    the bound so CI can gate on it)."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.hostprof import HOSTPROF
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    msgs = 6_000 if reduced else 15_000
+    ntopics = 64
+    payload = b"x" * 64
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _measure():
+        # host_profile=False at construction: the bench owns arm/disarm
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, host_profile=False, allow_anonymous=True)))
+        await b.start()
+        sr, sw, scodec = await _connect(b.port, "c14-sub")
+        sw.write(scodec.encode(pk.Subscribe(1, [("bench/#", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        await _read_until(sr, scodec, pk.Suback)
+        _pr, pw, pcodec = await _connect(b.port, "c14-pub")
+        frames = [pcodec.encode(pk.Publish(
+            topic=f"bench/t{i}", payload=payload, qos=0))
+            for i in range(ntopics)]
+
+        async def burst(n):
+            t0 = time.perf_counter()
+            sent = got = 0
+            deadline = time.monotonic() + 60.0
+            while sent < n:
+                k = min(64, n - sent)
+                pw.write(b"".join(
+                    frames[(sent + j) % ntopics] for j in range(k)))
+                sent += k
+                if pw.transport.get_write_buffer_size() > 1 << 18:
+                    await pw.drain()
+                while got < sent - 2048:
+                    data = await asyncio.wait_for(
+                        sr.read(1 << 16), deadline - time.monotonic())
+                    if not data:
+                        raise ConnectionError("subscriber closed")
+                    got += sum(1 for p in scodec.feed(data)
+                               if isinstance(p, pk.Publish))
+            await pw.drain()
+            while got < sent:
+                data = await asyncio.wait_for(
+                    sr.read(1 << 16), deadline - time.monotonic())
+                if not data:
+                    raise ConnectionError("subscriber closed")
+                got += sum(1 for p in scodec.feed(data)
+                           if isinstance(p, pk.Publish))
+            return time.perf_counter() - t0
+
+        def arm():
+            HOSTPROF.configure(enabled=True, dump_dir=None,
+                               telemetry=b.ctx.telemetry)
+            HOSTPROF.start()
+
+        async def disarm():
+            await HOSTPROF.stop()
+            HOSTPROF.configure(enabled=False)
+
+        prior_enabled = HOSTPROF.enabled
+        try:
+            await burst(1024)  # warm: codec, cache, deliver path
+            arm()
+            await burst(1024)
+            await disarm()
+            per = 256
+            pairs = []
+            done = 0
+            while done < msgs:
+                t_off1 = await burst(per)
+                arm()
+                t_on1 = await burst(per)
+                t_on2 = await burst(per)
+                await disarm()
+                t_off2 = await burst(per)
+                pairs.append((min(t_off1, t_off2), min(t_on1, t_on2)))
+                done += 2 * per
+            med_ratio = float(np.median([tn / tf for tf, tn in pairs]))
+            best_off = min(tf for tf, _ in pairs)
+            tele = b.ctx.telemetry
+            lat = {"e2e_p50": tele.p_ms("publish.e2e", 0.50),
+                   "e2e_p99": tele.p_ms("publish.e2e", 0.99)}
+            return per / best_off, med_ratio, lat
+        finally:
+            await HOSTPROF.stop()
+            HOSTPROF.configure(enabled=prior_enabled)
+            await b.stop()
+
+    tps_off, med_ratio, lat = asyncio.run(_measure())
+    overhead_pct = round((med_ratio - 1.0) * 100.0, 2)
+    res = {
+        "name": name,
+        "path": "broker_e2e_qos0_pipe",
+        "msgs_per_window": msgs,
+        "msgs_per_sec_off": round(tps_off, 1),
+        "msgs_per_sec_on": round(tps_off / med_ratio, 1),
+        "median_pair_ratio": round(med_ratio, 4),
+        "overhead_pct": overhead_pct,
+        "bound_pct": 2.0,
+        "ok": overhead_pct <= 2.0,
+        "latency_ms": lat,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] host profiler OFF {tps_off:.0f} msg/s, median pair "
+        f"ratio {res['median_pair_ratio']}x = {overhead_pct}% overhead "
+        f"(bound 2%) | e2e p50 {lat['e2e_p50']}ms → "
+        f"{'OK' if res['ok'] else 'FAIL'}")
+    return res
+
+
 def run_failover_config(name, rng, reduced):
     """Config 10: device-plane failover soak (broker/failover.py).
 
@@ -1878,7 +2024,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-12")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-14")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -1955,14 +2101,14 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 13
+            return i <= 14
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak), cfg9 (churn soak / delta uploads), cfg11
-        # (small-batch stage attribution), cfg12 (device-profiler
-        # overhead bound) and cfg13 (fabric-vs-broadcast fan-out) are
-        # cheap and always informative
-        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13)
+        # (small-batch stage attribution), cfg12/cfg14 (device/host
+        # profiler overhead bounds) and cfg13 (fabric-vs-broadcast
+        # fan-out) are cheap and always informative
+        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14)
                 or args.full or on_tpu)
 
     failures = {}
@@ -2106,6 +2252,13 @@ def main():
 
         guarded("cfg13_fabric_paired", cfg13)
 
+    if want(14):
+        def cfg14():
+            return run_hostprof_overhead_config("cfg14_hostprof_overhead",
+                                                rng, reduced)
+
+        guarded("cfg14_hostprof_overhead", cfg14)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -2117,10 +2270,32 @@ def main():
     smallbatch_res = results.pop("cfg11_smallbatch_paired", None)
     devprof_res = results.pop("cfg12_devprof_overhead", None)
     fabric_res = results.pop("cfg13_fabric_paired", None)
+    hostprof_res = results.pop("cfg14_hostprof_overhead", None)
+    if (not results and hostprof_res is not None and fabric_res is None
+            and devprof_res is None and smallbatch_res is None
+            and failover_res is None and churn_res is None
+            and overload_res is None and tele_res is None
+            and cache_res is None):
+        # a --config 14 run: its own artifact shape; the >2% bound FAILS
+        # the run (exit 1) so CI can gate on the host-profiler cost
+        print(json.dumps({
+            "metric": "hostprof_overhead_pct[cfg14_hostprof_overhead]",
+            "value": hostprof_res["overhead_pct"],
+            "unit": "pct_vs_off",
+            "vs_baseline": hostprof_res["overhead_pct"],
+            "ok": hostprof_res["ok"],
+            "platform": platform,
+            "hostprof_overhead": hostprof_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not hostprof_res["ok"]:
+            sys.exit(1)
+        return
     if (not results and fabric_res is not None and devprof_res is None
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
-            and tele_res is None and cache_res is None):
+            and tele_res is None and cache_res is None
+            and hostprof_res is None):
         # a --config 13 run: its own artifact shape; the ≥3× cross-worker
         # fan-out bound FAILS the run (exit 1) so CI can gate on it
         print(json.dumps({
@@ -2268,6 +2443,11 @@ def main():
         failures["cfg12_devprof_overhead"] = (
             f"profiler overhead {devprof_res['overhead_pct']}% > "
             f"{devprof_res['bound_pct']}% bound")
+    if hostprof_res is not None and not hostprof_res["ok"]:
+        # same contract for the host-plane profiler (cfg14)
+        failures["cfg14_hostprof_overhead"] = (
+            f"host profiler overhead {hostprof_res['overhead_pct']}% > "
+            f"{hostprof_res['bound_pct']}% bound")
 
     # headline = the largest routing config that ran
     if not results:
@@ -2353,6 +2533,10 @@ def main():
         # of the [observability] device_profile knob (broker/devprof.py)
         **({"devprof_overhead": devprof_res}
            if devprof_res is not None else {}),
+        # host-profiler overhead bound (cfg14): armed-vs-disarmed cost of
+        # the [observability] host_profile knob (broker/hostprof.py)
+        **({"hostprof_overhead": hostprof_res}
+           if hostprof_res is not None else {}),
         # intra-node fabric paired estimator (cfg13): cross-worker fan-out
         # goodput fabric-vs-broadcast + per-leg CONNECT kick p99
         # (broker/fabric.py)

@@ -158,6 +158,18 @@ class BrokerConfig:
     device_ring: int = 256  # flight-recorder record cap
     device_storm_n: int = 8  # traces within the window that flag a storm
     device_storm_window: float = 10.0  # seconds
+    # host-plane profiler (broker/hostprof.py, same [observability]
+    # section): event-loop lag sampler (scheduled-vs-actual wakeup delta
+    # into a log2 histogram, lag-storm detection), GC pause forensics via
+    # gc.callbacks, a blocking-call watchdog that captures the loop
+    # thread's frame stack into a bounded incident ring, and fixed-
+    # interval process rollups (fds / threads / executor / RSS).
+    # host_profile=false starts no task, installs no gc callback and keeps
+    # every seam at one attribute check.
+    host_profile: bool = True
+    host_block_ms: float = 150.0  # loop-tick gap that counts as blocked
+    host_lag_storm_n: int = 8  # laggy ticks within the window = a storm
+    host_lag_storm_window: float = 10.0  # seconds
     # overload-control subsystem (broker/overload.py, [overload] config
     # section): watermark-driven NORMAL/ELEVATED/CRITICAL states, token-
     # bucket admission, degradation tiers, circuit-broken egress. Disabled
@@ -484,6 +496,29 @@ class ServerContext:
             rmatcher = getattr(router, "matcher", None)
             if rmatcher is not None and hasattr(rmatcher, "stage_timing"):
                 rmatcher.stage_timing = True
+        # host-plane profiler (broker/hostprof.py): process-global like
+        # devprof (the event loop / GC / fd table it observes are
+        # process-global); the last-constructed context owns the telemetry
+        # ring + dispatch-probe wiring. The probe feeds the gc-during-
+        # dispatch correlation (how many routing batches were in flight
+        # when the collector stopped the world).
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        routing = self.routing
+
+        def _host_dispatch_probe(_r=routing) -> int:
+            return _r.inflight + _r._q.qsize()
+
+        self._host_dispatch_probe = _host_dispatch_probe
+        self._hostprof_started = False
+        HOSTPROF.configure(
+            enabled=self.cfg.host_profile,
+            block_ms=self.cfg.host_block_ms,
+            lag_storm_n=self.cfg.host_lag_storm_n,
+            lag_storm_window=self.cfg.host_lag_storm_window,
+            telemetry=self.telemetry,
+            dispatch_probe=_host_dispatch_probe,
+        )
 
     @property
     def handshaking(self) -> int:
@@ -553,6 +588,13 @@ class ServerContext:
         self.delayed.start()
         self.overload.start()
         self.slo.start()
+        # host-plane profiler: refcounted process-global start (a second
+        # in-process broker shares the one sampler); no-op when disabled
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        if HOSTPROF.enabled and not self._hostprof_started:
+            HOSTPROF.start()
+            self._hostprof_started = True
         if self.durability is not None:
             self.durability.start()
         if self._store_sweep_task is None:
@@ -586,6 +628,17 @@ class ServerContext:
         hp = DEVPROF.hbm_provider
         if hp is not None and getattr(hp, "__self__", None) is self.router:
             DEVPROF.configure(hbm_provider=None)
+        # same unhook discipline for the host profiler: release this
+        # context's refcount and drop closures that would pin the broker
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        if self._hostprof_started:
+            self._hostprof_started = False
+            await HOSTPROF.stop()
+        if HOSTPROF.telemetry is self.telemetry:
+            HOSTPROF.configure(telemetry=None)
+        if HOSTPROF.dispatch_probe is self._host_dispatch_probe:
+            HOSTPROF.configure(dispatch_probe=None)
 
     def stats(self) -> Stats:
         s = Stats()
@@ -643,6 +696,25 @@ class ServerContext:
         s.device_jit_traces = DEVPROF.traces
         s.device_jit_cache_hits = DEVPROF.cache_hits
         s.device_retrace_storms = DEVPROF.storms
+        # host-plane profiler gauges (broker/hostprof.py): loop-lag p99 +
+        # laggy/storm/blocked/gc counters; zeros while host_profile is off
+        # (the live /proc probes are skipped too — disabled costs nothing)
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        if HOSTPROF.enabled:
+            s.host_loop_lag_p99_ms = round(
+                HOSTPROF.lag_hist.quantile(0.99) / 1e6, 3)
+            s.host_loop_laggy_ticks = HOSTPROF.laggy_ticks
+            s.host_lag_storms = HOSTPROF.lag_storms
+            s.host_blocked_calls = HOSTPROF.blocked_calls
+            s.host_gc_pauses = sum(HOSTPROF.gc_pauses.values())
+            s.host_gc_pause_ms_total = round(
+                sum(HOSTPROF.gc_pause_ns.values()) / 1e6, 3)
+            from rmqtt_tpu.broker.hostprof import _fd_count
+            import threading as _threading
+
+            s.host_open_fds = _fd_count()
+            s.host_threads = _threading.active_count()
         hbm = getattr(self.router, "device_hbm", None)
         if callable(hbm):
             try:

@@ -233,6 +233,7 @@ class HttpApi:
                 "/api/v1/latency", "/api/v1/latency/sum",
                 "/api/v1/slo", "/api/v1/slo/sum",
                 "/api/v1/device", "/api/v1/device/sum",
+                "/api/v1/host", "/api/v1/host/sum",
                 "/api/v1/overload", "/api/v1/fabric",
                 "/api/v1/durability",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
@@ -426,6 +427,26 @@ class HttpApi:
             if q.get("flight", ["0"])[0] not in ("0", "", "false"):
                 body_out["flight"] = DEVPROF.flight()
             return 200, body_out, J
+        if path == "/api/v1/host/sum":
+            # cluster-wide host plane (broker/hostprof.py): counters sum,
+            # the loop-lag histograms BUCKET-MERGE like the latency
+            # surface (what=host DATA query per peer); incident detail
+            # stays per-node on each /api/v1/host
+            from rmqtt_tpu.broker.hostprof import HOSTPROF, HostProfiler
+
+            local = HOSTPROF.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "host"},
+                lambda r: [r["host"]] if "host" in r else [],
+            )
+            return 200, HostProfiler.merge_snapshots(local, peers), J
+        if path == "/api/v1/host":
+            # host-plane profiler (broker/hostprof.py): event-loop lag,
+            # GC pause forensics, blocking-call incidents (frame stacks),
+            # process rollups. Shape-stable with the profiler disabled.
+            from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+            return 200, {"node": ctx.node_id, **HOSTPROF.snapshot()}, J
         if path == "/api/v1/slo/sum":
             # cluster-wide SLO: per-objective (good, total) pairs sum
             # across nodes (cumulative + both windows), burn rates
@@ -677,6 +698,12 @@ class HttpApi:
         from rmqtt_tpu.broker.devprof import DEVPROF
 
         lines.extend(DEVPROF.prometheus_lines(labels))
+        # host-plane profiler families (broker/hostprof.py): loop-lag
+        # histogram, laggy-tick/storm/blocked counters, gc per-generation
+        # pause counters, fd/thread/executor gauges
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        lines.extend(HOSTPROF.prometheus_lines(labels))
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         # SLO gauges + good/bad event counters (broker/slo.py)
@@ -705,6 +732,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 <h2>SLO</h2><div class="cards" id="slo"></div>
 <h2>Overload</h2><div class="cards" id="overload"></div>
 <h2>Device plane</h2><div class="cards" id="device"></div>
+<h2>Host plane</h2><div class="cards" id="host"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
 <th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
@@ -725,7 +753,11 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_stage_fetch_ms_total","routing_stage_decode_ms_total",
  "fabric_batches","fabric_items","fabric_bytes_out","fabric_deliver_in",
  "fabric_deliver_out","fabric_kicks_o1","fabric_kick_rpcs",
- "fabric_plan_hits","directory_epoch",
+ "fabric_plan_hits","fabric_owner_reconnects","fabric_submit_fallbacks",
+ "directory_epoch",
+ "cluster_peers_alive","cluster_peers_suspect","cluster_peers_dead",
+ "cluster_membership_transitions","cluster_retain_sync_dropped",
+ "cluster_fence_kicks","cluster_anti_entropy_runs",
  "routing_stage_fabric_submit_ms_total",
  "routing_stage_fabric_fanout_ms_total",
  "durability_journal_len","durability_appends","durability_commits",
@@ -733,7 +765,10 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "durability_recovered_sessions","durability_recovered_subs",
  "durability_recovered_inflight","durability_recovery_ms",
  "device_jit_traces","device_jit_cache_hits","device_retrace_storms",
- "device_hbm_modeled_mb","routing_failover_state",
+ "device_hbm_modeled_mb",
+ "host_loop_laggy_ticks","host_lag_storms","host_blocked_calls",
+ "host_gc_pauses","host_gc_pause_ms_total","host_open_fds","host_threads",
+ "routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
  "routing_device_failures","slo_state","slo_transitions","rss_mb"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
@@ -791,6 +826,19 @@ async function tick(){
    `<div class="card"><div class="v">${esc(dd.p99_ms??0)}ms</div><div class="k">dispatch p99 (recent)</div></div>`+
    `<div class="card"><div class="v">${esc(((dh.modeled_bytes??0)/1048576).toFixed(1))}MB</div><div class="k">HBM modeled (${esc(dh.layout??"n/a")})</div></div>`+
    `<div class="card"><div class="v">${esc(dd.fused??0)}/${esc(dd.fallback??0)}</div><div class="k">fused / fallback</div></div>`;
+  const host=await j("/api/v1/host");
+  const hl=host.loop||{},hg=host.gc||{},hb=host.block||{},hp=host.proc||{};
+  const hex=(hp.executor||{});
+  document.getElementById("host").innerHTML=
+   (host.enabled?"":`<div class="card"><div class="v">off</div><div class="k">host profiler disabled</div></div>`)+
+   `<div class="card"><div class="v">${esc(hl.lag_p99_ms??0)}ms</div><div class="k">loop lag p99 (recent)</div></div>`+
+   `<div class="card"><div class="v"${(hl.storms??0)?' style="color:#b00020"':''}>${esc(hl.storms??0)}</div><div class="k">lag storms (laggy ${esc(hl.laggy_ticks??0)})</div></div>`+
+   `<div class="card"><div class="v"${(hb.blocked_calls??0)?' style="color:#b00020"':''}>${esc(hb.blocked_calls??0)}</div><div class="k">blocked calls (worst ${esc(hb.longest_block_ms??0)}ms)</div></div>`+
+   `<div class="card"><div class="v">${esc(hg.pauses??0)}</div><div class="k">gc pauses (${esc(hg.pause_ms_total??0)}ms total)</div></div>`+
+   `<div class="card"><div class="v">${esc(((hg.generations||{})["2"]||{}).p99_ms??0)}ms</div><div class="k">gen2 gc pause p99</div></div>`+
+   `<div class="card"><div class="v">${esc(hp.fds??0)}</div><div class="k">open fds</div></div>`+
+   `<div class="card"><div class="v">${esc(hex.threads??0)}/${esc(hex.queue??0)}</div><div class="k">executor threads/queued</div></div>`+
+   `<div class="card"><div class="v">${esc(hp.threads??0)}</div><div class="k">process threads</div></div>`;
   const lat=await j("/api/v1/latency");
   const hs=lat.histograms||{};
   document.getElementById("latency").innerHTML=

@@ -121,6 +121,19 @@ class Stats:
         # process resident set (utils/sysmon.py); a plain sum-mode float so
         # /stats/sum reports cluster-total memory
         self.rss_mb = 0.0
+        # host-plane profiler gauges (broker/hostprof.py), filled by
+        # ServerContext.stats(); zeros while host_profile is off so the
+        # observability surface stays shape-stable. lag p99 is avg-mode
+        # (`_ms`); gc_pause_ms_total is cumulative (`_total` → summed);
+        # the rest are counters / live process gauges (fds, threads)
+        self.host_loop_lag_p99_ms = 0.0
+        self.host_loop_laggy_ticks = 0
+        self.host_lag_storms = 0
+        self.host_blocked_calls = 0
+        self.host_gc_pauses = 0
+        self.host_gc_pause_ms_total = 0.0
+        self.host_open_fds = 0
+        self.host_threads = 0
         # device-plane failover gauges (broker/failover.py), overwritten
         # from RoutingService.stats(); zeros for routers without a host
         # fallback. state is 0=device (healthy) 1=host fallback 2=probing

@@ -434,6 +434,14 @@ class OverloadController:
                 "detail": {"from": old.name, "to": new.name,
                            "trigger": trigger, "signals": self.last_signals},
             })
+        # a CRITICAL escalation freezes the host-plane flight recorder
+        # (broker/hostprof.py): whether the pressure is host-made (GC,
+        # a wedged loop) or genuine load is the first triage question
+        if new >= OverloadState.CRITICAL:
+            from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+            if HOSTPROF.enabled:
+                HOSTPROF.auto_dump("overload_critical")
         snapshot = self.snapshot()
         try:
             loop = asyncio.get_running_loop()

@@ -304,6 +304,15 @@ class SloEngine:
                 "detail": {"objective": obj.name, "from": old.name,
                            "to": new.name, "target": obj.target},
             })
+        # entering BURNING/EXHAUSTED freezes the host-plane flight
+        # recorder (broker/hostprof.py): the budget started draining NOW,
+        # and the loop-lag / GC / blocking forensics of the last minutes
+        # are exactly what diagnoses it (rate-limited per reason)
+        if new > old:
+            from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+            if HOSTPROF.enabled:
+                HOSTPROF.auto_dump(f"slo_{new.name.lower()}")
         row = self._objective_row(obj, i, self._clock())
         try:
             loop = asyncio.get_running_loop()

@@ -363,6 +363,13 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             from rmqtt_tpu.broker.devprof import DEVPROF
 
             return {"device": DEVPROF.snapshot()}
+        if what == "host":
+            # per-node host-plane profiler snapshot for /api/v1/host/sum
+            # (broker/hostprof.py merge_snapshots: lag histograms
+            # bucket-merge, counters sum)
+            from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+            return {"host": HOSTPROF.snapshot()}
         if what == "traces":
             # trace-API cluster fetch (broker/tracing.py): by id → this
             # node's spans for that trace (the requester stitches);

@@ -373,6 +373,9 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
     # device_* knobs configure the device-plane profiler + flight recorder
     # (broker/devprof.py): jit shape-key registry / retrace-storm detector,
     # dispatch rollups, bounded flight ring + auto-dump triggers
+    # host_profile/block_ms/lag_storm_* configure the host-plane profiler
+    # (broker/hostprof.py): event-loop lag sampler + lag storms, GC pause
+    # forensics, blocking-call watchdog with frame-stack incident ring
     _apply_section(tree, "observability", {
         "enable": ("telemetry_enable", bool),
         "slow_ms": ("telemetry_slow_ms", float),
@@ -384,6 +387,10 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "device_ring": ("device_ring", int),
         "recompile_storm_n": ("device_storm_n", int),
         "recompile_storm_window": ("device_storm_window", float),
+        "host_profile": ("host_profile", bool),
+        "block_ms": ("host_block_ms", float),
+        "lag_storm_n": ("host_lag_storm_n", int),
+        "lag_storm_window": ("host_lag_storm_window", float),
     }, broker_kwargs)
     # [slo] — the live SLO engine (broker/slo.py): error budgets +
     # multi-window burn rates over the telemetry histograms and drop

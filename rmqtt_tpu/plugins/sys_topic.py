@@ -119,6 +119,7 @@ class SysTopicPlugin(Plugin):
                 await self._publish_latency()
                 await self._publish_tracing()
                 await self._publish_device()
+                await self._publish_host()
                 await self._publish_durability()
             await self._publish_slo()
             await self._publish_overload()
@@ -174,6 +175,35 @@ class SysTopicPlugin(Plugin):
         disp["rollups"] = disp.get("rollups", [])[-6:]  # bounded payload
         await self._publish(
             f"{self._prefix}/device/dispatch", json.dumps(disp).encode()
+        )
+
+    async def _publish_host(self) -> None:
+        """$SYS/brokers/<node>/host/{loop,gc,incidents}: the host-plane
+        profiler's loop-lag summary, GC per-generation pauses and the
+        blocking-call incident summary (broker/hostprof.py). Published
+        only while the profiler is enabled — host_profile=false brokers
+        keep their $SYS tree unchanged. Incident frame stacks stay on the
+        HTTP API (they're large and operator-eyes-only)."""
+        from rmqtt_tpu.broker.hostprof import HOSTPROF
+
+        if not HOSTPROF.enabled:
+            return
+        snap = HOSTPROF.snapshot()
+        loop_row = dict(snap["loop"])
+        loop_row.pop("lag_hist", None)  # raw buckets stay on the API
+        await self._publish(
+            f"{self._prefix}/host/loop", json.dumps(loop_row).encode()
+        )
+        await self._publish(
+            f"{self._prefix}/host/gc", json.dumps(snap["gc"]).encode()
+        )
+        blk = dict(snap["block"])
+        blk["incidents"] = [
+            {k: v for k, v in inc.items() if k != "stack"}
+            for inc in blk.get("incidents", [])[-8:]
+        ]
+        await self._publish(
+            f"{self._prefix}/host/incidents", json.dumps(blk).encode()
         )
 
     async def _publish_durability(self) -> None:

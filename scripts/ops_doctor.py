@@ -1,0 +1,469 @@
+#!/usr/bin/env python
+"""One-shot broker triage: pull every observability plane, correlate, report.
+
+The broker exposes eight planes (telemetry, tracing, SLO, devprof,
+hostprof, overload, fabric, durability), each answering one question well
+— but a paged operator's first question is *"which plane is it?"*. This
+CLI pulls the admin APIs from a live broker (or the cluster ``/sum``
+merges) and renders ONE triage report:
+
+  * a per-plane health line (latency quantiles, SLO budgets, device
+    compile/HBM, host loop/GC/blocking, overload state, breakers,
+    fabric, durability, cluster membership);
+  * ranked findings ("publish.e2e p99 412ms", "loop blocked 1.2s —
+    culprit stack: sqlite3 commit", "slo publish-e2e-p99 BURNING");
+  * **cross-plane correlation** over the shared slow-op ring: every
+    plane annotates the same timeline (slow publishes, gc pauses,
+    blocking incidents, lag storms, overload/slo transitions), so "p99
+    burst at t — coincides with gen2 GC pause 48ms + loop lag storm,
+    device plane clean" is a mechanical join, not an investigation.
+
+Usage:
+  python scripts/ops_doctor.py                          # localhost:6060
+  python scripts/ops_doctor.py --url http://host:6060   # one node
+  python scripts/ops_doctor.py --sum                    # cluster merges
+  python scripts/ops_doctor.py --json                   # machine-readable
+  python scripts/ops_doctor.py --dump hostprof_*.json   # render an artifact
+
+Exit codes: 0 = no findings, 1 = findings, 2 = collection failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+#: slow-op ring events from different planes within this many seconds of
+#: each other are reported as one correlated episode
+CORRELATE_WINDOW_S = 2.0
+
+#: plane → endpoint (``/sum`` variants used with --sum where they exist)
+ENDPOINTS = {
+    "stats": ("/api/v1/stats", None),
+    "latency": ("/api/v1/latency", "/api/v1/latency/sum"),
+    "slo": ("/api/v1/slo", "/api/v1/slo/sum"),
+    "device": ("/api/v1/device", "/api/v1/device/sum"),
+    "host": ("/api/v1/host", "/api/v1/host/sum"),
+    "overload": ("/api/v1/overload", None),
+    "failover": ("/api/v1/routing/failover", None),
+    "fabric": ("/api/v1/fabric", None),
+    "durability": ("/api/v1/durability", None),
+    "cluster": ("/api/v1/cluster", None),
+}
+
+
+def collect(base_url: str, use_sum: bool = False,
+            timeout: float = 5.0) -> Dict[str, Any]:
+    """Fetch every plane; a single unreachable endpoint degrades to an
+    ``{"_error": ...}`` stub so the report renders what it got."""
+    planes: Dict[str, Any] = {}
+    for plane, (path, sum_path) in ENDPOINTS.items():
+        url = base_url.rstrip("/") + (sum_path if use_sum and sum_path
+                                      else path)
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                planes[plane] = json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            planes[plane] = {"_error": f"{url}: {e}"}
+    return planes
+
+
+# ------------------------------------------------------------------ findings
+def _f(plane: str, severity: str, msg: str) -> dict:
+    return {"plane": plane, "severity": severity, "msg": msg}
+
+
+def _lat_ms(hist_row: dict, q: str) -> float:
+    """ns-unit histogram row → quantile in ms."""
+    return round(float(hist_row.get(q, 0)) / 1e6, 1)
+
+
+def diagnose(planes: Dict[str, Any]) -> List[dict]:
+    """Pure rule pass over the collected planes → ranked findings."""
+    out: List[dict] = []
+    for plane, snap in planes.items():
+        if isinstance(snap, dict) and snap.get("_error"):
+            out.append(_f(plane, "WARN", f"unreachable: {snap['_error']}"))
+
+    lat = planes.get("latency") or {}
+    hists = lat.get("histograms") or {}
+    e2e = hists.get("publish.e2e") or {}
+    if e2e.get("count"):
+        p99 = _lat_ms(e2e, "p99")
+        if p99 >= 100.0:
+            out.append(_f("latency", "WARN",
+                          f"publish.e2e p99 {p99}ms over {e2e['count']} "
+                          f"publishes"))
+
+    slo = planes.get("slo") or {}
+    for obj in slo.get("objectives") or ():
+        if obj.get("state_value", 0) > 0:
+            out.append(_f(
+                "slo", "CRIT" if obj["state"] == "EXHAUSTED" else "WARN",
+                f"objective {obj['name']} {obj['state']} (fast burn "
+                f"{obj['fast']['burn_rate']}x, slow "
+                f"{obj['slow']['burn_rate']}x, budget "
+                f"{obj.get('budget_remaining', '?')})"))
+
+    host = planes.get("host") or {}
+    blk = host.get("block") or {}
+    if blk.get("blocked_calls"):
+        tail = ""
+        incidents = blk.get("incidents") or ()
+        if incidents:
+            stack = incidents[-1].get("stack") or ()
+            if stack:
+                tail = " — culprit: " + stack[-1].strip().split("\n")[0]
+        out.append(_f("host", "WARN",
+                      f"{blk['blocked_calls']} blocking-call incident(s), "
+                      f"worst {blk.get('longest_block_ms', 0)}ms{tail}"))
+    hloop = host.get("loop") or {}
+    if hloop.get("storms"):
+        out.append(_f("host", "WARN",
+                      f"{hloop['storms']} event-loop lag storm(s), max lag "
+                      f"{hloop.get('max_lag_ms', 0)}ms"))
+    gens = (host.get("gc") or {}).get("generations") or {}
+    g2 = gens.get("2") or {}
+    if g2.get("p99_ms", 0) >= 20.0:
+        out.append(_f("host", "WARN",
+                      f"gen2 GC pause p99 {g2['p99_ms']}ms over "
+                      f"{g2.get('pauses', 0)} collections"))
+
+    dev = planes.get("device") or {}
+    comp = dev.get("compile") or {}
+    if comp.get("storms"):
+        out.append(_f("device", "WARN",
+                      f"{comp['storms']} retrace storm(s) — shape "
+                      f"discipline broke down (see /api/v1/device kernels)"))
+    disp = dev.get("dispatch") or {}
+    if disp.get("pad_waste", 0) >= 0.5 and disp.get("dispatches", 0) > 100:
+        out.append(_f("device", "INFO",
+                      f"pad waste {disp['pad_waste']:.0%} (floor "
+                      f"{disp.get('pad_floor', 1)}) — small-batch regime"))
+
+    fo = planes.get("failover") or {}
+    if fo.get("state_value", 0) > 0:
+        out.append(_f("failover", "CRIT",
+                      f"device failover {fo.get('state', '?')} — publishes "
+                      f"served from the host trie mirror"))
+
+    ov = planes.get("overload") or {}
+    if ov.get("state_value", 0) > 0:
+        out.append(_f(
+            "overload", "CRIT" if ov["state"] == "CRITICAL" else "WARN",
+            f"overload {ov['state']} (trigger {ov.get('trigger')}, "
+            f"signals {ov.get('signals')})"))
+    for name, b in (ov.get("breakers") or {}).items():
+        if b.get("state") != "closed":
+            out.append(_f("overload", "WARN",
+                          f"breaker {name} {b['state']} (opens "
+                          f"{b.get('opens', 0)}, retry in "
+                          f"{b.get('retry_in_s', 0)}s)"))
+
+    fab = planes.get("fabric") or {}
+    fallbacks = (fab.get("counters") or {}).get("submit_fallbacks", 0)
+    if fab.get("enabled") and fallbacks:
+        out.append(_f("fabric", "WARN",
+                      f"{fallbacks} fabric submit fallback(s) — owner "
+                      f"outages degraded publishes to worker-local match"))
+
+    cl = planes.get("cluster") or {}
+    # /api/v1/cluster nests the failure detector under "membership";
+    # "peers" is a LIST of per-peer snapshots (cluster/membership.py)
+    peers = (cl.get("membership") or {}).get("peers") or []
+    bad = [p.get("node") for p in peers
+           if isinstance(p, dict) and p.get("state") in ("SUSPECT", "DEAD")]
+    if bad:
+        out.append(_f("cluster", "CRIT",
+                      f"peers not ALIVE: {sorted(bad)}"))
+
+    sev_rank = {"CRIT": 0, "WARN": 1, "INFO": 2}
+    out.sort(key=lambda f: sev_rank.get(f["severity"], 3))
+    return out
+
+
+# -------------------------------------------------------------- correlation
+def correlate(slow_ops: List[dict],
+              window_s: float = CORRELATE_WINDOW_S) -> List[dict]:
+    """Join the shared slow-op ring across planes: for every host/overload/
+    slo event, collect the slow data-plane ops within ``window_s`` of it.
+    → episodes [{ts, events: [...], slow_stages: [...]}]."""
+    anchors = [op for op in slow_ops
+               if str(op.get("op", "")).split(".")[0] in
+               ("host", "overload", "slo", "device")]
+    stages = [op for op in slow_ops
+              if str(op.get("op", "")).split(".")[0] not in
+              ("host", "overload", "slo", "device")]
+    episodes: List[dict] = []
+    for anchor in anchors:
+        ts = float(anchor.get("ts", 0))
+        near_anchor = [a for a in anchors
+                       if a is not anchor
+                       and abs(float(a.get("ts", 0)) - ts) <= window_s]
+        near_slow = [s for s in stages
+                     if abs(float(s.get("ts", 0)) - ts) <= window_s]
+        # merge into an existing episode when anchors overlap in time
+        for ep in episodes:
+            if abs(ep["ts"] - ts) <= window_s:
+                if anchor not in ep["events"]:
+                    ep["events"].append(anchor)
+                for s in near_slow:
+                    if s not in ep["slow_stages"]:
+                        ep["slow_stages"].append(s)
+                break
+        else:
+            episodes.append({
+                "ts": ts,
+                "events": [anchor, *near_anchor],
+                "slow_stages": near_slow,
+            })
+    return episodes
+
+
+def _event_phrase(op: dict) -> str:
+    name = op.get("op", "?")
+    d = op.get("detail") or {}
+    if name == "host.gc_pause":
+        extra = (f" (during {d['in_dispatch']} in-flight dispatches)"
+                 if d.get("in_dispatch") else "")
+        return (f"gen{d.get('generation', '?')} GC pause "
+                f"{d.get('pause_ms', op.get('ms', 0))}ms{extra}")
+    if name == "host.blocked":
+        return f"loop blocked {d.get('blocked_ms', op.get('ms', 0))}ms"
+    if name == "host.lag_storm":
+        return (f"loop lag storm ({d.get('laggy_in_window', '?')} laggy "
+                f"ticks in {d.get('window_s', '?')}s)")
+    if name == "overload.state":
+        return f"overload {d.get('from')}→{d.get('to')} ({d.get('trigger')})"
+    if name == "slo.state":
+        return f"slo {d.get('objective')} {d.get('from')}→{d.get('to')}"
+    if name == "device.retrace_storm":
+        return (f"retrace storm ({d.get('traces_in_window', '?')} jit "
+                f"traces)")
+    return name
+
+
+def episode_lines(episodes: List[dict], device_clean: bool) -> List[str]:
+    out = []
+    for ep in sorted(episodes, key=lambda e: e["ts"]):
+        when = time.strftime("%H:%M:%S", time.localtime(ep["ts"]))
+        phrases = [_event_phrase(e) for e in ep["events"]]
+        slow = ep["slow_stages"]
+        if slow:
+            worst = max(slow, key=lambda s: float(s.get("ms", 0)))
+            head = (f"{worst.get('op')} {worst.get('ms')}ms burst at {when}"
+                    f" ({len(slow)} slow op(s))")
+            out.append(f"{head} — coincides with: " + " + ".join(phrases)
+                       + ("; device plane clean" if device_clean else ""))
+        else:
+            out.append(f"at {when}: " + " + ".join(phrases)
+                       + ("; device plane clean" if device_clean else ""))
+    return out
+
+
+# ------------------------------------------------------------------ report
+def _status(findings: List[dict], plane: str) -> str:
+    sev = [f["severity"] for f in findings if f["plane"] == plane]
+    if "CRIT" in sev:
+        return "CRIT"
+    if "WARN" in sev:
+        return "WARN"
+    return "ok"
+
+
+def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
+    """→ (report text, findings). Pure — testable offline on snapshots."""
+    findings = diagnose(planes)
+    out: List[str] = []
+    stats_rows = planes.get("stats") or []
+    node = "?"
+    if isinstance(stats_rows, list) and stats_rows:
+        node = stats_rows[0].get("node", "?")
+    out.append(f"ops doctor — node {node} at "
+               f"{time.strftime('%Y-%m-%d %H:%M:%S')}")
+    out.append("")
+
+    lat = planes.get("latency") or {}
+    hists = lat.get("histograms") or {}
+    line = []
+    for stage in ("publish.e2e", "routing.match", "deliver.ack_rtt"):
+        row = hists.get(stage)
+        if row and row.get("count"):
+            line.append(f"{stage} p50 {_lat_ms(row, 'p50')}ms / "
+                        f"p99 {_lat_ms(row, 'p99')}ms (n={row['count']})")
+    out.append(f"[{_status(findings, 'latency'):4}] latency   " +
+               ("; ".join(line) if line else "no samples"))
+
+    slo = planes.get("slo") or {}
+    objs = slo.get("objectives") or ()
+    out.append(f"[{_status(findings, 'slo'):4}] slo       state "
+               f"{slo.get('state', '?')}; " + "; ".join(
+                   f"{o['name']} budget {o.get('budget_remaining', '?')}"
+                   for o in objs))
+
+    dev = planes.get("device") or {}
+    comp, disp = dev.get("compile") or {}, dev.get("dispatch") or {}
+    hbm = dev.get("hbm") or {}
+    out.append(
+        f"[{_status(findings, 'device'):4}] device    "
+        f"{disp.get('dispatches', 0)} dispatches (p99 "
+        f"{disp.get('p99_ms', 0)}ms, fused {disp.get('fused', 0)}), "
+        f"{comp.get('traces', 0)} jit traces / {comp.get('storms', 0)} "
+        f"storms, hbm {round((hbm.get('modeled_bytes', 0)) / 2**20, 1)}MB")
+
+    host = planes.get("host") or {}
+    hloop, hgc = host.get("loop") or {}, host.get("gc") or {}
+    hblk, hproc = host.get("block") or {}, host.get("proc") or {}
+    out.append(
+        f"[{_status(findings, 'host'):4}] host      loop lag p99 "
+        f"{hloop.get('lag_p99_ms', 0)}ms (max {hloop.get('max_lag_ms', 0)}"
+        f"ms, {hloop.get('storms', 0)} storms), gc {hgc.get('pauses', 0)} "
+        f"pauses/{hgc.get('pause_ms_total', 0)}ms, blocked "
+        f"{hblk.get('blocked_calls', 0)}x, fds {hproc.get('fds', 0)}, "
+        f"rss {round(hproc.get('rss_mb', 0) or 0, 1)}MB")
+
+    ov = planes.get("overload") or {}
+    open_brk = [n for n, b in (ov.get("breakers") or {}).items()
+                if b.get("state") != "closed"]
+    out.append(f"[{_status(findings, 'overload'):4}] overload  state "
+               f"{ov.get('state', '?')}"
+               + (f", open breakers {open_brk}" if open_brk else ""))
+
+    fo = planes.get("failover") or {}
+    out.append(f"[{_status(findings, 'failover'):4}] failover  "
+               f"{fo.get('state', 'unavailable')}")
+
+    fab = planes.get("fabric") or {}
+    out.append(f"[{_status(findings, 'fabric'):4}] fabric    "
+               + (f"role {fab.get('role', '?')}, gen "
+                  f"{fab.get('table_gen', '?')}, fallbacks "
+                  f"{(fab.get('counters') or {}).get('submit_fallbacks', 0)}"
+                  if fab.get("enabled") else "disabled"))
+
+    dur = planes.get("durability") or {}
+    out.append(f"[{_status(findings, 'durability'):4}] durability "
+               + (f"journal {(dur.get('journal') or {}).get('len', '?')} "
+                  f"rows, {dur.get('commits', 0)} commits, last recovery "
+                  f"{dur.get('recovery_ms', 0)}ms"
+                  if dur.get("enabled") else "disabled"))
+
+    cl = planes.get("cluster") or {}
+    peer_rows = (cl.get("membership") or {}).get("peers") or []
+    out.append(f"[{_status(findings, 'cluster'):4}] cluster   "
+               + (f"{len(peer_rows)} peers ("
+                  + (", ".join(f"{p.get('node')}={p.get('state')}"
+                               for p in peer_rows) or "none")
+                  + ")" if cl.get("enabled") else "single node"))
+
+    out.append("")
+    if findings:
+        out.append("== findings ==")
+        for f in findings:
+            out.append(f"  {f['severity']:4} [{f['plane']}] {f['msg']}")
+    else:
+        out.append("== findings == none — all planes nominal")
+
+    # cross-plane correlation over the shared slow-op ring
+    slow_ops = lat.get("slow_ops") or []
+    device_clean = (not comp.get("storms")
+                    and not (planes.get("failover") or {}).get(
+                        "state_value", 0))
+    episodes = correlate(slow_ops)
+    out.append("")
+    out.append("== cross-plane correlation (slow-op ring) ==")
+    lines = episode_lines(episodes, device_clean)
+    if lines:
+        out.extend("  " + ln for ln in lines)
+    else:
+        out.append("  no correlated episodes in the ring")
+    return "\n".join(out), findings
+
+
+# ------------------------------------------------------------ dump renderer
+def render_host_dump(dump: dict, flight_tail: int = 8) -> str:
+    """Render a ``rmqtt_tpu.hostprof_dump/1`` artifact (the auto-dumped
+    postmortem) — incidents with culprit stacks, the rollup timeline and
+    the correlated slow-op tail."""
+    snap = dump.get("snapshot") or {}
+    loop = snap.get("loop") or {}
+    gcd = snap.get("gc") or {}
+    blk = snap.get("block") or {}
+    out: List[str] = []
+    out.append(f"hostprof dump — reason: {dump.get('reason', '?')} "
+               f"ts: {dump.get('ts', '?')}")
+    out.append(
+        f"loop: {loop.get('ticks', 0)} ticks, lag p99 "
+        f"{loop.get('lag_p99_ms', 0)}ms (max {loop.get('max_lag_ms', 0)}ms),"
+        f" {loop.get('laggy_ticks', 0)} laggy, {loop.get('storms', 0)} "
+        f"storms")
+    out.append(
+        f"gc: {gcd.get('pauses', 0)} pauses, "
+        f"{gcd.get('pause_ms_total', 0)}ms total; per gen: " + ", ".join(
+            f"g{g}={row.get('pauses', 0)}x/"
+            f"{row.get('pause_ms_total', 0)}ms"
+            for g, row in sorted((gcd.get("generations") or {}).items())))
+    out.append(f"blocked: {blk.get('blocked_calls', 0)} incident(s), worst "
+               f"{blk.get('longest_block_ms', 0)}ms")
+    for inc in (blk.get("incidents") or [])[-4:]:
+        out.append(f"\n== incident @ {inc.get('ts')} — "
+                   f"{inc.get('blocked_ms')}ms blocked ==")
+        for line in (inc.get("stack") or [])[-10:]:
+            out.append("  " + line)
+    out.append("\n== host timeline (interval rollups) ==")
+    rows = snap.get("rollups") or []
+    hdr = ["t", "ticks", "laggy", "lag_p99_ms", "gc", "gc_ms", "blocked",
+           "fds", "exq", "rss_mb"]
+    out.append("  ".join(hdr))
+    for r in rows[-20:]:
+        out.append("  ".join(str(r.get(k)) for k in (
+            "t", "ticks", "laggy", "lag_p99_ms", "gc_pauses", "gc_pause_ms",
+            "blocked", "fds", "executor_queue", "rss_mb")))
+    slow = dump.get("slow_ops") or []
+    out.append(f"\n== slow-op ring tail (last {flight_tail} of "
+               f"{len(slow)}) ==")
+    for op in slow[-flight_tail:]:
+        out.append(json.dumps(op, sort_keys=True))
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:6060",
+                    help="broker admin API base (default localhost:6060)")
+    ap.add_argument("--sum", action="store_true",
+                    help="use the cluster /sum merges where available")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw planes + findings as JSON")
+    ap.add_argument("--dump", help="render a hostprof_dump artifact "
+                                   "instead of querying a broker")
+    args = ap.parse_args()
+    if args.dump:
+        with open(args.dump) as f:
+            dump = json.load(f)
+        if dump.get("schema") != "rmqtt_tpu.hostprof_dump/1":
+            print(f"warning: unexpected schema {dump.get('schema')!r}",
+                  file=sys.stderr)
+        print(render_host_dump(dump))
+        return 0
+    planes = collect(args.url, use_sum=args.sum)
+    if all(isinstance(p, dict) and p.get("_error")
+           for p in planes.values()):
+        print(f"ops_doctor: broker unreachable at {args.url}",
+              file=sys.stderr)
+        return 2
+    text, findings = render(planes)
+    if args.json:
+        print(json.dumps({"planes": planes, "findings": findings},
+                         indent=1, default=str))
+    else:
+        print(text)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

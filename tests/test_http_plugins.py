@@ -130,7 +130,10 @@ def test_sys_topic_plugin():
             c = await TestClient.connect(b.port, "syswatcher")
             await c.subscribe("$SYS/#", qos=0)
             seen = set()
-            for _ in range(8):
+            # read budget covers one full periodic cycle: the $SYS tree
+            # now spans latency/tracing/device/host/slo rows per tick, so
+            # joining mid-cycle can put a dozen topics before stats
+            for _ in range(30):
                 p = await c.recv(timeout=3.0)
                 seen.add(p.topic.rsplit("/", 1)[-1])
                 if {"stats", "version"} <= seen:

@@ -262,8 +262,23 @@ def test_throttle_long_run_rate_accuracy():
 
 
 # ------------------------------------------------------------- E2E helpers
-async def _raw_connect(port, cid, version=pk.V311, keepalive=600):
-    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+async def _raw_connect(port, cid, version=pk.V311, keepalive=600,
+                       rcvbuf=None):
+    if rcvbuf:
+        import socket as _s
+
+        # shrink the client's receive window BEFORE connect (the kernel
+        # scales the window from the buffer at handshake): the flood's
+        # backlog must land in the broker's deliver queue — the thing the
+        # overload controller manages — not in kernel socket buffering
+        sk = _s.socket()
+        sk.setsockopt(_s.SOL_SOCKET, _s.SO_RCVBUF, rcvbuf)
+        sk.setblocking(False)
+        await asyncio.get_running_loop().sock_connect(
+            sk, ("127.0.0.1", port))
+        reader, writer = await asyncio.open_connection(sock=sk)
+    else:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
     codec = MqttCodec(version)
     writer.write(codec.encode(pk.Connect(client_id=cid, protocol=version,
                                          keepalive=keepalive)))
@@ -292,14 +307,47 @@ def _overload_cfg(**kw):
     return BrokerConfig(**base)
 
 
-async def _flood_slow_consumer(broker, n_msgs=1500, payload=b"x" * 2048):
+async def _flood_slow_consumer(broker, payload=b"x" * 2048):
     """Subscriber that never reads + a QoS0 flood; returns the publisher
     client (still connected). The subscriber's socket backpressure stalls
-    its deliver loop, so its bounded deliver queue fills."""
-    sr, sw, scodec = await _raw_connect(broker.port, "ov-sub")
+    its deliver loop, so its bounded deliver queue fills.
+
+    Deterministic on any host: explicit SO_RCVBUF/SO_SNDBUF on BOTH ends
+    of the subscriber connection, and the blast sized from the values the
+    kernel actually granted (getsockopt — Linux doubles the request) plus
+    the deliver-queue capacity and the asyncio write-buffer high-water
+    slack, so queue overflow cannot depend on host socket-buffer defaults
+    (the PR 12-era flake: default-autotuned buffers absorbed the whole
+    flood and the queue never filled)."""
+    import socket as _socket
+
+    req_buf = 32 * 1024
+    sr, sw, scodec = await _raw_connect(broker.port, "ov-sub",
+                                        rcvbuf=req_buf)
     sw.write(scodec.encode(pk.Subscribe(1, [("ov/#", pk.SubOpts(qos=1))])))
     await sw.drain()
-    # deliberately NOT reading from sr anymore: slow consumer
+    # deliberately NOT reading from sr anymore: slow consumer.
+    # Wait for the broker-side session, then shrink ITS send buffer too.
+    deadline = time.monotonic() + 10.0
+    srv = None
+    while time.monotonic() < deadline:
+        srv = broker.ctx.registry.get("ov-sub")
+        if srv is not None and "ov/#" in srv.subscriptions:
+            break
+        await asyncio.sleep(0.01)
+    assert srv is not None and "ov/#" in srv.subscriptions
+    srv_sock = srv.state.writer.get_extra_info("socket")
+    assert srv_sock is not None
+    srv_sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, req_buf)
+    sndbuf = srv_sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF)
+    rcvbuf = sw.get_extra_info("socket").getsockopt(
+        _socket.SOL_SOCKET, _socket.SO_RCVBUF)
+    # size the blast from the CONFIGURED values: kernel buffers both ends
+    # + the broker's bounded deliver queue + asyncio transport high-water
+    # slack, 3x over so overflow is unconditional
+    queue_bytes = broker.ctx.cfg.fitter.max_mqueue * len(payload)
+    absorb = sndbuf + rcvbuf + queue_bytes + 256 * 1024
+    n_msgs = max(800, 3 * absorb // len(payload))
     pub = await TestClient.connect(broker.port, "ov-pub")
     for i in range(n_msgs):
         await pub.publish("ov/t", payload, qos=0, wait_ack=False)
