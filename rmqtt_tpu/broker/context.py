@@ -226,6 +226,21 @@ class BrokerConfig:
     failover_cooldown: float = 1.0  # first probe delay (exp backoff after)
     failover_max_cooldown: float = 30.0
     failover_k_successes: int = 3  # consecutive canary passes to switch back
+    # device-plane autotuner (broker/autotune.py, [routing] autotune* keys):
+    # closed-loop controller from devprof rollups + routing telemetry to
+    # the live knob registry (broker/knobs.py) — hysteresis-guarded
+    # hill-climbing, one knob at a time, each change a canary epoch with
+    # instant rollback + cooldown. Default OFF: enable=false starts no
+    # task, writes no knob, and every surface stays shape-stable.
+    autotune_enable: bool = False
+    autotune_interval_s: float = 5.0  # controller tick period
+    autotune_canary_k: int = 8  # dispatches that must vouch for a change
+    autotune_cooldown_s: float = 30.0  # knob quarantine after a rollback
+    autotune_p99_guard: float = 2.0  # canary p99 ceiling vs baseline
+    # (2.0 = one log2 histogram bucket: adjacent-bucket moves are
+    # quantization noise, two buckets is a real regression)
+    autotune_confirm_ticks: int = 2  # consecutive ticks before a move
+    autotune_journal_max: int = 256  # bounded decision-journal ring
     # crash-safe durability plane (broker/durability.py, [durability] conf
     # section): group-committed write-ahead journal of retained / session /
     # subscription / QoS1-2 pending state over a SqliteStore (or redis via
@@ -476,6 +491,33 @@ class ServerContext:
                 metrics=self.metrics,
                 telemetry=self.telemetry,
             )
+        # runtime knob registry (broker/knobs.py): every device/batcher
+        # kill-switch bound to its live object with provenance — the
+        # autotuner's single read/write seam and /api/v1/routing/knobs.
+        # Binding is read-only; building it changes no behavior.
+        from rmqtt_tpu.broker.knobs import build_registry
+
+        self.knobs = build_registry(router, self.routing, self.cfg)
+        # device-plane autotuner (broker/autotune.py): constructed
+        # unconditionally (like overload/slo) so /api/v1/autotune and the
+        # gauges stay shape-stable; disabled = no task, no knob writes
+        from rmqtt_tpu.broker.autotune import AutotuneService
+
+        self.autotune = AutotuneService(
+            self.knobs,
+            enabled=self.cfg.autotune_enable,
+            interval_s=self.cfg.autotune_interval_s,
+            canary_k=self.cfg.autotune_canary_k,
+            cooldown_s=self.cfg.autotune_cooldown_s,
+            p99_guard=self.cfg.autotune_p99_guard,
+            confirm_ticks=self.cfg.autotune_confirm_ticks,
+            journal_max=self.cfg.autotune_journal_max,
+            routing=self.routing,
+            router=router,
+            telemetry=self.telemetry,
+            metrics=self.metrics,
+            node_id=self.cfg.node_id,
+        )
         # device-plane profiler + flight recorder (broker/devprof.py):
         # process-global like the failpoint registry (the jit caches it
         # models are process-global); the last-constructed context owns the
@@ -588,6 +630,7 @@ class ServerContext:
         self.delayed.start()
         self.overload.start()
         self.slo.start()
+        self.autotune.start()  # no-op while [routing] autotune = false
         # host-plane profiler: refcounted process-global start (a second
         # in-process broker shares the one sampler); no-op when disabled
         from rmqtt_tpu.broker.hostprof import HOSTPROF
@@ -613,6 +656,7 @@ class ServerContext:
             self._store_sweep_task = None
         if self.durability is not None:
             await self.durability.stop()
+        await self.autotune.stop()
         await self.slo.stop()
         await self.overload.stop()
         await self.routing.stop()
@@ -672,6 +716,11 @@ class ServerContext:
         # SLO gauges (broker/slo.py): worst objective state + transitions
         s.slo_state = int(self.slo.worst_state)
         s.slo_transitions = self.slo.transitions
+        # autotuner gauges (broker/autotune.py): decision/commit/rollback
+        # counters (summable in /stats/sum); zeros while disabled
+        s.autotune_decisions = self.autotune.decisions
+        s.autotune_commits = self.autotune.commits
+        s.autotune_rollbacks = self.autotune.rollbacks
         # cluster membership + partition-healing gauges
         # (cluster/membership.py); the counters exist (zero) on single-node
         # brokers too, so dashboards keep one shape

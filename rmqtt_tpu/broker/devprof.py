@@ -81,8 +81,9 @@ _KEY_ROWS_MAX = 128
 class _Rollup:
     """One fixed-interval dispatch bucket (the time-series element)."""
 
-    __slots__ = ("t", "dispatches", "items", "padded", "hist",
-                 "delta_bytes", "full_bytes", "fused", "fallback", "traces")
+    __slots__ = ("t", "dispatches", "items", "padded", "hist", "whist",
+                 "bhist", "delta_bytes", "full_bytes", "fused", "fallback",
+                 "traces")
 
     def __init__(self, t: int) -> None:
         self.t = t
@@ -90,6 +91,19 @@ class _Rollup:
         self.items = 0
         self.padded = 0
         self.hist = Histogram()  # active dispatch-path ns (submit+complete)
+        # warm-only subset: dispatches that carried NO fresh jit trace.
+        # The autotuner's canary compares steady-state p99 against its
+        # baseline — a ladder step legitimately compiles its new shape
+        # once, and judging that one-off against the guard would veto
+        # every exploration (the trace budget bounds compile COUNT
+        # separately)
+        self.whist = Histogram()
+        # per-dispatch batch-size distribution (log2 buckets, mergeable by
+        # addition like every Histogram): the autotuner's primary
+        # regime-detection signal — pad-waste alone can't distinguish
+        # "steady batch-1 traffic" from "mixed small batches", and the two
+        # regimes want different pad floors (broker/autotune.py)
+        self.bhist = Histogram()
         self.delta_bytes = 0
         self.full_bytes = 0
         self.fused = 0
@@ -106,6 +120,16 @@ class _Rollup:
             if self.padded else 0.0,
             "p50_ms": round(self.hist.quantile(0.50) / 1e6, 3),
             "p99_ms": round(self.hist.quantile(0.99) / 1e6, 3),
+            "warm_p99_ms": round(self.whist.quantile(0.99) / 1e6, 3),
+            # quantiles are the bucket's EXCLUSIVE upper bound (exact to
+            # one log2 bucket); batch_hist keys are those bounds too, so
+            # consumers (autotune replay) merge rows by key addition
+            "batch_p50": int(self.bhist.quantile(0.50)),
+            "batch_p99": int(self.bhist.quantile(0.99)),
+            "batch_hist": {
+                str(Histogram.bucket_upper(i)): c
+                for i, c in enumerate(self.bhist.counts) if c
+            },
             "delta_bytes": self.delta_bytes,
             "full_bytes": self.full_bytes,
             "fused": self.fused,
@@ -271,8 +295,12 @@ class DeviceProfiler:
 
     # ------------------------------------------------------- dispatch ring
     def _rollup(self) -> _Rollup:
-        """Current interval bucket (caller holds the lock)."""
-        t = int(time.time() // self.interval_s * self.interval_s)
+        """Current interval bucket (caller holds the lock). The bucket key
+        must keep the interval's resolution — int() truncation collapsed
+        every sub-second interval onto 1s buckets, which silently starved
+        any consumer windowing finer than a second (the autotuner's bench
+        cadence)."""
+        t = round(time.time() // self.interval_s * self.interval_s, 3)
         if not self._rollups or self._rollups[-1].t != t:
             self._rollups.append(_Rollup(t))
         return self._rollups[-1]
@@ -298,6 +326,9 @@ class DeviceProfiler:
             r.items += rec.get("batch", 0)
             r.padded += rec.get("padded", 0)
             r.hist.record(dispatch_ns)
+            if not rec.get("traces"):
+                r.whist.record(dispatch_ns)
+            r.bhist.record(rec.get("batch", 0))
             if rec.get("fused"):
                 r.fused += 1
             else:
@@ -331,9 +362,11 @@ class DeviceProfiler:
         """The matcher latched a new sticky pad floor (prewarm / change):
         log it with the current cumulative waste fraction and annotate the
         slow ring, so the cfg1 small-batch regime shows WHY it pays what
-        it pays."""
+        it pays. Tracks the reported value directly — the autotuner's
+        ladder LOWERS the floor too (broker/autotune.py), so a monotonic
+        max here would misreport the live setting."""
         with self._lock:
-            self.pad_floor = max(self.pad_floor, floor)
+            self.pad_floor = max(1, floor)
             waste = (round(1.0 - self.items_total / self.padded_total, 4)
                      if self.padded_total else 0.0)
         _LOG.info(
@@ -342,6 +375,52 @@ class DeviceProfiler:
             old, floor, waste)
         self._annotate_ring("device.pad_floor", {
             "floor": floor, "old": old, "pad_waste": waste})
+
+    def rollup_summary(self, since: Optional[float] = None,
+                       n: Optional[int] = None) -> dict:
+        """Rollup CONSUMER API (the autotuner's signal source): merge the
+        interval buckets at/after ``since`` (or the newest ``n``; the
+        newest 6 by default) into one window summary — dispatch count,
+        pad-waste fraction, dispatch p50/p99 and the batch-size quantiles,
+        upload bytes, fused/fallback share, traces. Cheaper than
+        ``snapshot()`` (no kernel tables, no HBM provider call — the
+        provider may touch ``jax.live_arrays``) so a controller can poll
+        it every few seconds."""
+        with self._lock:
+            rolls = list(self._rollups)
+        if since is not None:
+            rolls = [r for r in rolls if r.t + self.interval_s > since]
+        elif n is not None:
+            rolls = rolls[-max(0, n):]
+        else:
+            rolls = rolls[-6:]
+        hist = Histogram()
+        whist = Histogram()
+        bhist = Histogram()
+        out = {"intervals": len(rolls), "dispatches": 0, "items": 0,
+               "padded": 0, "traces": 0, "fused": 0, "fallback": 0,
+               "delta_bytes": 0, "full_bytes": 0}
+        for r in rolls:
+            out["dispatches"] += r.dispatches
+            out["items"] += r.items
+            out["padded"] += r.padded
+            out["traces"] += r.traces
+            out["fused"] += r.fused
+            out["fallback"] += r.fallback
+            out["delta_bytes"] += r.delta_bytes
+            out["full_bytes"] += r.full_bytes
+            hist.merge(r.hist)
+            whist.merge(r.whist)
+            bhist.merge(r.bhist)
+        out["pad_waste"] = (round(1.0 - out["items"] / out["padded"], 4)
+                            if out["padded"] else 0.0)
+        out["p50_ms"] = round(hist.quantile(0.50) / 1e6, 3)
+        out["p99_ms"] = round(hist.quantile(0.99) / 1e6, 3)
+        out["warm_dispatches"] = whist.count
+        out["warm_p99_ms"] = round(whist.quantile(0.99) / 1e6, 3)
+        out["batch_p50"] = int(bhist.quantile(0.50))
+        out["batch_p99"] = int(bhist.quantile(0.99))
+        return out
 
     def _annotate_ring(self, op: str, detail: dict) -> None:
         """Slow-op ring annotation (the timeline operators read for stalls

@@ -59,6 +59,35 @@ def _swallow_abandoned(fut) -> None:
         fut.exception()
 
 
+def device_verify(router, k: int = 1,
+                  static_topic: str = "rmqtt/failover/canary"
+                  ) -> Optional[bool]:
+    """``k`` consecutive canary matches through the DEVICE matcher checked
+    against the host trie oracle — the verify step shared by the failover
+    plane's half-open probe and the autotuner's canary epochs
+    (broker/autotune.py): both must prove "the device still answers
+    CORRECTLY under the current settings" before trusting a transition.
+
+    → True (all canaries agreed), False (mismatch or canary raised), or
+    None when the router exposes no device canary entry point (trie-only
+    routers; the caller decides whether that means pass or fail — the
+    probe fails closed, the autotuner skips the check).
+
+    Topics derive from live filters where possible (router.canary_topics):
+    on a non-empty table a static unmatched topic would compare
+    empty-vs-empty and vacuously pass a device that recovered into wrong
+    answers."""
+    canary = getattr(router, "device_canary", None)
+    if not callable(canary):
+        return None
+    ct = getattr(router, "canary_topics", None)
+    topics = (ct() if callable(ct) else []) or [static_topic]
+    for _ in range(max(1, int(k))):
+        if not canary(topics):
+            return False
+    return True
+
+
 def classify(exc: BaseException, default: str) -> str:
     """Refine a call-site reason (dispatch/complete) by exception content:
     HBM refresh failures — a real device OOM on upload after table growth,
@@ -241,22 +270,14 @@ class DeviceFailover:
         rewarm = getattr(self.router, "device_rewarm", None)
         if callable(rewarm):
             rewarm()
-        canary = getattr(self.router, "device_canary", None)
-        if not callable(canary):
+        ok = device_verify(self.router, self.k_successes, self.canary_topic)
+        if ok is None:
+            return False  # no canary entry point: fail closed, stay on host
+        if not ok:
+            self.failures["canary_mismatch"] += 1
+            if self.metrics is not None:
+                self.metrics.inc("routing.failover.failures.canary_mismatch")
             return False
-        # canary against topics derived from LIVE filters where possible:
-        # the static topic matches nothing, so on a non-empty table it
-        # would compare empty-vs-empty and pass a device that recovered
-        # into wrong answers (an empty table has nothing to misroute, so
-        # the static fallback is then an honest liveness check)
-        ct = getattr(self.router, "canary_topics", None)
-        topics = (ct() if callable(ct) else []) or [self.canary_topic]
-        for _ in range(self.k_successes):
-            if not canary(topics):
-                self.failures["canary_mismatch"] += 1
-                if self.metrics is not None:
-                    self.metrics.inc("routing.failover.failures.canary_mismatch")
-                return False
         return True
 
     # ---------------------------------------------------------- transitions

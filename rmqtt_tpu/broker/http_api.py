@@ -236,7 +236,9 @@ class HttpApi:
                 "/api/v1/host", "/api/v1/host/sum",
                 "/api/v1/overload", "/api/v1/fabric",
                 "/api/v1/durability",
+                "/api/v1/autotune", "/api/v1/autotune/sum",
                 "/api/v1/failpoints", "/api/v1/routing/failover",
+                "/api/v1/routing/knobs",
                 "/api/v1/traces", "/api/v1/traces/slow",
                 "/api/v1/traces/{trace_id}",
                 "/api/v1/plugins", "/api/v1/plugins/{plugin}",
@@ -511,6 +513,30 @@ class HttpApi:
             body_out = (fab.snapshot() if fab is not None
                         else {"enabled": False})
             return 200, {"node": ctx.node_id, **body_out}, J
+        if path == "/api/v1/autotune/sum":
+            # cluster-wide autotuner counters (broker/autotune.py):
+            # decisions/commits/rollbacks sum, state merges by worst;
+            # journals stay per-node (what=autotune DATA query per peer)
+            from rmqtt_tpu.broker.autotune import AutotuneService
+
+            local = ctx.autotune.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "autotune"},
+                lambda r: [r["autotune"]] if "autotune" in r else [],
+            )
+            return 200, AutotuneService.merge_snapshots(local, peers), J
+        if path == "/api/v1/autotune":
+            # device-plane autotuner (broker/autotune.py): state, canary
+            # in flight, bounded decision journal (before/after metrics
+            # per knob change) and the live knob table. Shape-stable with
+            # the plane disabled (zeros + empty journal).
+            return 200, {"node": ctx.node_id, **ctx.autotune.snapshot()}, J
+        if path == "/api/v1/routing/knobs":
+            # the consolidated runtime knob registry (broker/knobs.py):
+            # every device/batcher kill-switch with its live value and
+            # provenance (default | env | conf | autotune)
+            return 200, {"node": ctx.node_id,
+                         "knobs": ctx.knobs.snapshot()}, J
         if path == "/api/v1/routing/failover":
             # device-plane failover state (broker/failover.py): breaker,
             # host-routed counters, reason-labeled failures; a static
@@ -704,6 +730,9 @@ class HttpApi:
         from rmqtt_tpu.broker.hostprof import HOSTPROF
 
         lines.extend(HOSTPROF.prometheus_lines(labels))
+        # autotuner families (broker/autotune.py): enabled/state gauges +
+        # canary/commit/rollback/hold counters
+        lines.extend(self.ctx.autotune.prometheus_lines(labels))
         # latency stage histograms (_bucket/_sum/_count families)
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         # SLO gauges + good/bad event counters (broker/slo.py)
@@ -732,6 +761,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 <h2>SLO</h2><div class="cards" id="slo"></div>
 <h2>Overload</h2><div class="cards" id="overload"></div>
 <h2>Device plane</h2><div class="cards" id="device"></div>
+<h2>Autotune</h2><div class="cards" id="autotune"></div>
 <h2>Host plane</h2><div class="cards" id="host"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
@@ -826,6 +856,16 @@ async function tick(){
    `<div class="card"><div class="v">${esc(dd.p99_ms??0)}ms</div><div class="k">dispatch p99 (recent)</div></div>`+
    `<div class="card"><div class="v">${esc(((dh.modeled_bytes??0)/1048576).toFixed(1))}MB</div><div class="k">HBM modeled (${esc(dh.layout??"n/a")})</div></div>`+
    `<div class="card"><div class="v">${esc(dd.fused??0)}/${esc(dd.fallback??0)}</div><div class="k">fused / fallback</div></div>`;
+  const at=await j("/api/v1/autotune");
+  const lastd=(at.journal||[]).slice(-1)[0];
+  document.getElementById("autotune").innerHTML=
+   `<div class="card"><div class="v"${at.state_value===2?' style="color:#b00020"':''}>${esc(at.state)}</div><div class="k">autotune${at.enabled?"":" (disabled)"}</div></div>`+
+   `<div class="card"><div class="v">${esc(at.decisions??0)}</div><div class="k">decisions</div></div>`+
+   `<div class="card"><div class="v">${esc(at.commits??0)}</div><div class="k">commits</div></div>`+
+   `<div class="card"><div class="v"${(at.rollbacks??0)?' style="color:#b00020"':''}>${esc(at.rollbacks??0)}</div><div class="k">rollbacks (aborts ${esc(at.aborts??0)})</div></div>`+
+   (lastd?`<div class="card"><div class="v">${esc(lastd.knob)} ${esc(lastd.from)}&rarr;${esc(lastd.to)}</div><div class="k">last: ${esc(lastd.phase)} (${esc(lastd.reason)})</div></div>`:"")+
+   (at.knobs||[]).map(k=>
+    `<div class="card"><div class="v">${esc(k.value)}</div><div class="k">knob ${esc(k.name)} (${esc(k.source)})</div></div>`).join("");
   const host=await j("/api/v1/host");
   const hl=host.loop||{},hg=host.gc||{},hb=host.block||{},hp=host.proc||{};
   const hex=(hp.executor||{});

@@ -118,6 +118,12 @@ class Stats:
         # 2=EXHAUSTED (slow-window error budget fully spent)
         self.slo_state = 0
         self.slo_transitions = 0
+        # autotuner gauges (broker/autotune.py), overwritten by
+        # ServerContext.stats(): canary epochs started / committed /
+        # rolled back — summable counts (zeros while the plane is off)
+        self.autotune_decisions = 0
+        self.autotune_commits = 0
+        self.autotune_rollbacks = 0
         # process resident set (utils/sysmon.py); a plain sum-mode float so
         # /stats/sum reports cluster-total memory
         self.rss_mb = 0.0

@@ -215,6 +215,17 @@ class RoutingService:
                 round(self.fabric.fanout_ms_total, 3) if self.fabric else 0.0),
         }
 
+    def set_batch_window(self, max_batch: Optional[int] = None,
+                         linger_ms: Optional[float] = None) -> None:
+        """Knob seam (broker/knobs.py / the autotuner): retune the batcher
+        live. ``_collect`` reads both per dispatch, so the next batch
+        collected after this call already runs under the new window — no
+        queue drain or task restart involved."""
+        if max_batch is not None:
+            self.max_batch = max(1, int(max_batch))
+        if linger_ms is not None:
+            self.linger = max(0.0, float(linger_ms)) / 1000.0
+
     def queue_fraction(self) -> float:
         """Ingress-queue fullness in [0, 1] — the overload controller's
         routing-backlog pressure signal (broker/overload.py)."""

@@ -363,6 +363,11 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             from rmqtt_tpu.broker.devprof import DEVPROF
 
             return {"device": DEVPROF.snapshot()}
+        if what == "autotune":
+            # per-node autotuner snapshot for /api/v1/autotune/sum
+            # (broker/autotune.py merge_snapshots: counters sum, state
+            # merges by worst; journals stay per-node)
+            return {"autotune": ctx.autotune.snapshot()}
         if what == "host":
             # per-node host-plane profiler snapshot for /api/v1/host/sum
             # (broker/hostprof.py merge_snapshots: lag histograms

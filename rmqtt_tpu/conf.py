@@ -329,6 +329,15 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "failover_cooldown": ("failover_cooldown", float),
         "failover_max_cooldown": ("failover_max_cooldown", float),
         "failover_k_successes": ("failover_k_successes", int),
+        # device-plane autotuner (broker/autotune.py): closed-loop knob
+        # selection from devprof rollups. Default OFF (pinned zero change).
+        "autotune": ("autotune_enable", bool),
+        "autotune_interval_s": ("autotune_interval_s", float),
+        "autotune_canary_k": ("autotune_canary_k", int),
+        "autotune_cooldown_s": ("autotune_cooldown_s", float),
+        "autotune_p99_guard": ("autotune_p99_guard", float),
+        "autotune_confirm_ticks": ("autotune_confirm_ticks", int),
+        "autotune_journal_max": ("autotune_journal_max", int),
     }, broker_kwargs)
     # [fabric] — intra-node routing fabric (broker/fabric.py): one router
     # owner per node serving every SO_REUSEPORT worker over a UDS mesh.

@@ -132,6 +132,15 @@ class AdaptiveHybrid:
             return "side" if s >= d else "device"
 
     # ------------------------------------------------------------------ api
+    def set_small_max(self, n: int) -> int:
+        """Knob seam (broker/knobs.py via XlaRouter.set_hybrid_max): move
+        the trie-vs-device threshold live; → the old value. The EMA state
+        deliberately survives — the rates measured per path stay valid,
+        only the boundary between them moves."""
+        old = self.small_max
+        self.small_max = max(0, int(n))
+        return old
+
     @property
     def choice(self) -> Optional[str]:
         """Current steady-state routing for large batches (None = unprimed)."""

@@ -1783,8 +1783,14 @@ class PartitionedMatcher:
         self._dev_playout = None  # PackedLayout of the resident tiles (None = legacy)
         self._dev_fids = None  # device row→fid map [up_chunks, CHUNK] int32
         # sticky small-batch pad floor (prewarm): tiny batches pad UP to one
-        # already-compiled shape instead of compiling shapes 1/2/4/... each
-        self._pad_floor = 1
+        # already-compiled shape instead of compiling shapes 1/2/4/... each.
+        # RMQTT_PAD_FLOOR seeds it at construction (the autotune-replay
+        # seam: chip_hunter --autotune starts a window pre-tuned instead of
+        # from defaults) and PINS it against prewarm()'s default latch —
+        # a fitted seed of 2 must survive broker start, not get re-raised
+        # to 8. The live autotuner still moves it via set_pad_floor().
+        self._pad_floor_pinned = os.environ.get("RMQTT_PAD_FLOOR", "") != ""
+        self._pad_floor = max(1, int(os.environ.get("RMQTT_PAD_FLOOR", "1")))
         # device-plane profiler glue (broker/devprof.py): submit-half flight
         # records awaiting their complete half, matched by handle IDENTITY
         # (so _complete_segmented's recursive sub-completes never consume a
@@ -2694,13 +2700,19 @@ class PartitionedMatcher:
         Safe to call from a background thread at broker start; matches
         run against the live table and results are discarded."""
         sizes = sorted(set(int(s) for s in batch_sizes if s > 0))
+        if self._pad_floor_pinned:
+            # an explicit RMQTT_PAD_FLOOR seed (autotune replay) outranks
+            # the default latch: warm the SEEDED floor's shape and leave
+            # the floor where the operator/fitter put it
+            sizes = [self._pad_floor]
         if not sizes:
             return
         try:
             for s in sizes:
                 self.match(["\x00prewarm/nomatch"] * s)
             old = self._pad_floor
-            self._pad_floor = max(self._pad_floor, sizes[-1])
+            if not self._pad_floor_pinned:
+                self._pad_floor = max(self._pad_floor, sizes[-1])
             if _DEVPROF.enabled:
                 # pad-waste visibility (floor changes included): the cfg1
                 # small-batch regime must SHOW why it pays what it pays
@@ -2711,6 +2723,18 @@ class PartitionedMatcher:
         except Exception as e:  # pragma: no cover - defensive
             _LOG.warning("matcher prewarm failed (%s); first small "
                          "publishes will pay the compile", e)
+
+    def set_pad_floor(self, floor: int) -> int:
+        """Knob seam (broker/knobs.py): set the sticky pad floor to an
+        exact value — unlike ``prewarm()``'s monotonic latch this may
+        LOWER it (the autotuner's ladder; a new smaller shape compiles
+        once on next use, a cost the canary epoch weighs). → the old
+        floor (the rollback token)."""
+        old = self._pad_floor
+        self._pad_floor = max(1, int(floor))
+        if self._pad_floor != old and _DEVPROF.enabled:
+            _DEVPROF.note_pad_floor(self._pad_floor, old)
+        return old
 
     def hbm_breakdown(self) -> dict:
         """Live HBM occupancy model of this matcher's device residency:

@@ -119,6 +119,7 @@ class SysTopicPlugin(Plugin):
                 await self._publish_latency()
                 await self._publish_tracing()
                 await self._publish_device()
+                await self._publish_autotune()
                 await self._publish_host()
                 await self._publish_durability()
             await self._publish_slo()
@@ -175,6 +176,22 @@ class SysTopicPlugin(Plugin):
         disp["rollups"] = disp.get("rollups", [])[-6:]  # bounded payload
         await self._publish(
             f"{self._prefix}/device/dispatch", json.dumps(disp).encode()
+        )
+
+    async def _publish_autotune(self) -> None:
+        """$SYS/brokers/<node>/autotune: the autotuner's state + counters
+        + the newest journal entries (broker/autotune.py). Published only
+        while the plane is enabled — the disabled default keeps the $SYS
+        tree unchanged (the zero-behavior-change pin); the full journal
+        and knob table stay on the HTTP API."""
+        at = getattr(self.ctx, "autotune", None)
+        if at is None or not at.enabled:
+            return
+        snap = at.snapshot()
+        snap.pop("knobs", None)
+        snap["journal"] = snap.get("journal", [])[-8:]  # bounded payload
+        await self._publish(
+            f"{self._prefix}/autotune", json.dumps(snap).encode()
         )
 
     async def _publish_host(self) -> None:

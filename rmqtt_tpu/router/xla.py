@@ -275,6 +275,16 @@ class XlaRouter(Router):
         if m is not None and hasattr(m, "prewarm"):
             m.prewarm(batch_sizes)
 
+    def set_hybrid_max(self, n: int) -> int:
+        """Knob seam (broker/knobs.py): move the trie-vs-device batch
+        threshold live — both the inline_ok gate and the hybrid's own
+        small_max, which must agree or sub-threshold batches would take
+        the executor hop without the trie fast path. → the old value."""
+        old = self._hybrid_max
+        self._hybrid_max = max(0, int(n))
+        self._hybrid.set_small_max(self._hybrid_max)
+        return old
+
     def last_match_was_device(self) -> bool:
         """Did the most recent (synchronously resolved) match run on the
         DEVICE matcher? The routing service consults this before crediting

@@ -85,8 +85,14 @@ def parse_round(path: str) -> Optional[dict]:
     if n is None:
         m = re.search(r"r(\d+)", os.path.basename(path))
         n = int(m.group(1)) if m else 0
+    def usable(b) -> bool:
+        # a round is trendable with a configs table OR a special-shape
+        # block we synthesize a config entry from (cfg15 standalone runs)
+        return isinstance(b, dict) and bool(
+            b.get("configs") or b.get("autotune_paired"))
+
     body = art.get("parsed")
-    if not isinstance(body, dict) or not body.get("configs"):
+    if not usable(body):
         body = None
         tail = art.get("tail") or ""
         # newest-first: the last parseable whole-line JSON object wins
@@ -97,7 +103,7 @@ def parse_round(path: str) -> Optional[dict]:
                     cand = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(cand, dict) and cand.get("configs"):
+                if usable(cand):
                     body = cand
                     break
         if body is None and tail:
@@ -120,8 +126,22 @@ def parse_round(path: str) -> Optional[dict]:
                         "recovered_from_tail": True}
     if body is None:
         return None
+    # special-shape configs that ride the artifact OUTSIDE the configs
+    # table get synthesized entries so the trend (and the regression
+    # gate) track them like any other config. cfg15: the autotune leg's
+    # goodput is the tracked number, the pair ratio rides as "speedup".
+    body_configs = dict(body.get("configs") or {})
+    ap = body.get("autotune_paired")
+    if isinstance(ap, dict) and isinstance(ap.get("autotune"), dict):
+        body_configs.setdefault("cfg15_autotune_paired", {
+            "tpu_topics_per_sec":
+                ap["autotune"].get("goodput_topics_per_sec"),
+            "p99_ms": ap["autotune"].get("p99_small_ms"),
+            "speedup": ap.get("pair_ratio"),
+            **({"reduced_sizes": True} if ap.get("reduced_sizes") else {}),
+        })
     configs = {}
-    for name, entry in (body.get("configs") or {}).items():
+    for name, entry in body_configs.items():
         if not isinstance(entry, dict):
             continue
         goodput = None

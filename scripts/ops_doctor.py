@@ -51,6 +51,7 @@ ENDPOINTS = {
     "host": ("/api/v1/host", "/api/v1/host/sum"),
     "overload": ("/api/v1/overload", None),
     "failover": ("/api/v1/routing/failover", None),
+    "autotune": ("/api/v1/autotune", "/api/v1/autotune/sum"),
     "fabric": ("/api/v1/fabric", None),
     "durability": ("/api/v1/durability", None),
     "cluster": ("/api/v1/cluster", None),
@@ -146,6 +147,22 @@ def diagnose(planes: Dict[str, Any]) -> List[dict]:
                       f"pad waste {disp['pad_waste']:.0%} (floor "
                       f"{disp.get('pad_floor', 1)}) — small-batch regime"))
 
+    at = planes.get("autotune") or {}
+    if at.get("cooldowns"):
+        # gate on ACTIVE quarantine, not the lifetime rollback counter —
+        # a long-recovered day-1 rollback is history, not a finding
+        last = next((e for e in reversed(at.get("journal") or [])
+                     if e.get("phase") == "rollback"), {})
+        out.append(_f("autotune", "WARN",
+                      f"knob(s) in rollback cooldown "
+                      f"{sorted(at['cooldowns'])} — last: "
+                      f"{last.get('knob')} {last.get('to')} "
+                      f"({last.get('reason')}); "
+                      f"{at.get('rollbacks', 0)} rollback(s) total"))
+    if at.get("state") == "hold":
+        out.append(_f("autotune", "INFO",
+                      "exploration held (retrace storm in window)"))
+
     fo = planes.get("failover") or {}
     if fo.get("state_value", 0) > 0:
         out.append(_f("failover", "CRIT",
@@ -195,10 +212,10 @@ def correlate(slow_ops: List[dict],
     → episodes [{ts, events: [...], slow_stages: [...]}]."""
     anchors = [op for op in slow_ops
                if str(op.get("op", "")).split(".")[0] in
-               ("host", "overload", "slo", "device")]
+               ("host", "overload", "slo", "device", "autotune")]
     stages = [op for op in slow_ops
               if str(op.get("op", "")).split(".")[0] not in
-              ("host", "overload", "slo", "device")]
+              ("host", "overload", "slo", "device", "autotune")]
     episodes: List[dict] = []
     for anchor in anchors:
         ts = float(anchor.get("ts", 0))
@@ -337,6 +354,13 @@ def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
     fo = planes.get("failover") or {}
     out.append(f"[{_status(findings, 'failover'):4}] failover  "
                f"{fo.get('state', 'unavailable')}")
+
+    at = planes.get("autotune") or {}
+    out.append(f"[{_status(findings, 'autotune'):4}] autotune  "
+               + (f"{at.get('state', '?')}, {at.get('commits', 0)} commits"
+                  f"/{at.get('rollbacks', 0)} rollbacks"
+                  f"/{at.get('holds', 0)} holds"
+                  if at.get("enabled") else "disabled"))
 
     fab = planes.get("fabric") or {}
     out.append(f"[{_status(findings, 'fabric'):4}] fabric    "

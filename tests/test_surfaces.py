@@ -40,6 +40,9 @@ DASH_CARD_RENDERED = {
     "overload_state", "overload_transitions", "overload_open_breakers",
     # host-plane card (loop lag p99 from /api/v1/host)
     "host_loop_lag_p99_ms",
+    # autotune cards (state/decisions/commits/rollbacks + last decision
+    # from /api/v1/autotune)
+    "autotune_decisions", "autotune_commits", "autotune_rollbacks",
     # enable flags rendered as card presence, not numbers
     "fabric_enabled", "fabric_owner", "durability_enabled",
 }
