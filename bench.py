@@ -2279,6 +2279,210 @@ def run_autotune_config(name, rng, reduced):
     return res
 
 
+def run_egress_config(name, rng, reduced):
+    """Config 16: coalesced egress vs legacy per-frame writes at
+    64-subscriber fan-out, cfg13-style order-symmetric paired estimator.
+
+    Two live single-worker brokers in one process, identical except for
+    ``[network] egress_coalesce``: the COALESCED leg batches every frame
+    queued for a connection within one loop tick into a single vectored
+    write (broker/egress.py); the LEGACY leg is the pre-coalescer data
+    plane — one transport write per outbound frame. The workload is the
+    fan-out shape where per-frame writes dominate: 64 subscribers
+    sharing one wildcard filter, so each QoS0 publish becomes 64
+    outbound frames and the write-call count is the data plane's real
+    syscall budget. Bursts alternate legs in order-symmetric quads
+    (coalesced, legacy, legacy, coalesced) with each condition keeping
+    its best burst; the artifact carries syscalls-per-delivered-message
+    per leg — the coalesced leg counts its ACTUAL vectored writes via
+    the ``net.egress_flushes`` counter delta, the legacy send path is
+    structurally one transport write per frame (broker/session.py
+    send_raw) — plus the goodput ratio. Targets: ≥5x fewer send
+    syscalls per delivered message and ≥1.25x goodput."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.fitter import FitterConfig
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    nsubs = 64  # the fan-out fleet, one shared wildcard filter
+    npubs = 32  # concurrent publishers: the coalescing window is one loop
+    # tick, so frames-per-flush scales with how many publisher sessions
+    # route a publish in the same tick (the production fan-in shape)
+    per = 256 if reduced else 512  # publishes per burst (×nsubs deliveries)
+    quads = 2 if reduced else 3
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _leg(coalesce):
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, telemetry_enable=False, egress_coalesce=coalesce,
+            fitter=FitterConfig(max_mqueue=100_000))))
+        await b.start()
+        return b
+
+    async def _wire_traffic(broker, tag, coalesce):
+        """64 subscribers on eg/# + npubs publishers; → (burst, close).
+        burst(n) → (active-window seconds, deliveries, send calls)."""
+        subs = []
+        for k in range(nsubs):
+            r, w, c = await _connect(broker.port, f"{tag}s{k}")
+            w.write(c.encode(pk.Subscribe(
+                1, [("eg/#", pk.SubOpts(qos=0))])))
+            await w.drain()
+            await _read_until(r, c, pk.Suback)
+            subs.append((r, w, c))
+        pubs = [await _connect(broker.port, f"{tag}p{k}")
+                for k in range(npubs)]
+        frames = [pubs[0][2].encode(pk.Publish(
+            topic=f"eg/t{i}", payload=b"x" * 512, qos=0))
+            for i in range(32)]
+        metrics = broker.ctx.metrics
+
+        async def burst(n):
+            got = [0] * len(subs)
+            done = asyncio.Event()
+            want_total = n * len(subs)
+            total = [0]
+            last = [0.0]  # timestamp of the latest delivery (effective end)
+
+            async def drain(si, reader, codec):
+                while total[0] < want_total:
+                    try:
+                        data = await asyncio.wait_for(reader.read(1 << 16), 2.0)
+                    except asyncio.TimeoutError:
+                        return  # QoS0: late stragglers are counted as lost
+                    if not data:
+                        return
+                    k = sum(1 for p in codec.feed(data)
+                            if isinstance(p, pk.Publish))
+                    got[si] += k
+                    total[0] += k
+                    last[0] = time.perf_counter()
+                    if total[0] >= want_total:
+                        done.set()
+
+            w0 = metrics.get("net.egress_flushes")
+            t0 = time.perf_counter()
+            drains = [asyncio.get_running_loop().create_task(
+                drain(si, r, c)) for si, (r, _w, c) in enumerate(subs)]
+
+            async def feed(pi, count):
+                _r, w, _c = pubs[pi]
+                sent = 0
+                while sent < count:
+                    k = min(32, count - sent)
+                    w.write(b"".join(frames[(sent + j) % 32]
+                                     for j in range(k)))
+                    sent += k
+                    await w.drain()
+
+            await asyncio.gather(*(feed(pi, n // npubs)
+                                   for pi in range(npubs)))
+            try:
+                await asyncio.wait_for(done.wait(), 30.0)
+            except asyncio.TimeoutError:
+                pass
+            elapsed = (last[0] or time.perf_counter()) - t0
+            for t in drains:
+                t.cancel()
+            # send calls: the coalesced leg's flush counter counts each
+            # vectored write it issued; the legacy path is one
+            # transport.write per frame, i.e. exactly the delivery count
+            writes = ((metrics.get("net.egress_flushes") - w0)
+                      if coalesce else total[0])
+            return max(elapsed, 1e-6), total[0], writes
+
+        async def close():
+            for r, w, _c in [*subs, *pubs]:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        return burst, close
+
+    async def _measure():
+        cb = await _leg(True)
+        lb = await _leg(False)
+        try:
+            c_burst, c_close = await _wire_traffic(cb, "c", True)
+            l_burst, l_close = await _wire_traffic(lb, "l", False)
+            await c_burst(64)  # warm both paths (codec, routes, buffers)
+            await l_burst(64)
+            pairs = []
+            deliv_c = writes_c = deliv_l = writes_l = 0
+            for _ in range(quads):
+                # order-symmetric quad (coal, legacy, legacy, coal):
+                # each condition keeps its BEST goodput of its two
+                # bursts, filtering one-sided load spikes (cfg13 rule)
+                ec1, nc1, wc1 = await c_burst(per)
+                el1, nl1, wl1 = await l_burst(per)
+                el2, nl2, wl2 = await l_burst(per)
+                ec2, nc2, wc2 = await c_burst(per)
+                pairs.append((max(nc1 / ec1, nc2 / ec2),
+                              max(nl1 / el1, nl2 / el2)))
+                deliv_c += nc1 + nc2
+                writes_c += wc1 + wc2
+                deliv_l += nl1 + nl2
+                writes_l += wl1 + wl2
+            # counter snapshot BEFORE teardown: closing the sessions
+            # fires their final flushes and would skew the totals
+            eg = {k: cb.ctx.metrics.get(f"net.egress_{k}")
+                  for k in ("frames", "flushes", "coalesced", "bytes")}
+            await c_close()
+            await l_close()
+            return pairs, (deliv_c, writes_c), (deliv_l, writes_l), eg
+        finally:
+            await cb.stop()
+            await lb.stop()
+
+    pairs, (dc, wc), (dl, wl), eg = asyncio.run(_measure())
+    ratio = float(np.median([gc / gl for gc, gl in pairs]))
+    spm_c = wc / max(1, dc)
+    spm_l = wl / max(1, dl)  # 1.0 by construction (one write per frame)
+    reduction = spm_l / max(1e-9, spm_c)
+    res = {
+        "name": name,
+        "subscribers": nsubs,
+        "publishers": npubs,
+        "msgs_per_burst": per,
+        "fanout_goodput_coalesced": round(max(gc for gc, _ in pairs), 1),
+        "fanout_goodput_legacy": round(max(gl for _, gl in pairs), 1),
+        "goodput_ratio": round(ratio, 3),
+        "syscalls_per_msg_coalesced": round(spm_c, 4),
+        "syscalls_per_msg_legacy": round(spm_l, 4),
+        "syscall_reduction_x": round(reduction, 2),
+        "egress_counters": eg,
+        "target_syscall_reduction": 5.0,
+        "target_goodput_ratio": 1.25,
+        "ok": reduction >= 5.0 and ratio >= 1.25,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] {nsubs}-sub fan-out: coalesced "
+        f"{res['fanout_goodput_coalesced']:.0f} vs legacy "
+        f"{res['fanout_goodput_legacy']:.0f} deliveries/s → {ratio:.2f}x "
+        f"goodput (target ≥1.25x) | {spm_c:.3f} vs {spm_l:.3f} "
+        f"send calls/msg → {reduction:.1f}x fewer (target ≥5x)")
+    return res
+
+
 def tpu_available(probe_timeout: float = 60.0, retries: int = 2) -> bool:
     """Probe the TPU in a subprocess (see rmqtt_tpu.utils.tpuprobe: the axon
     grant can be wedged, making in-process jax.devices() block forever)."""
@@ -2291,7 +2495,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-15")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-16")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -2368,15 +2572,15 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 15
+            return i <= 16
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak), cfg9 (churn soak / delta uploads), cfg11
         # (small-batch stage attribution), cfg12/cfg14 (device/host
-        # profiler overhead bounds), cfg13 (fabric-vs-broadcast fan-out)
-        # and cfg15 (autotune-vs-static shifting regime) are cheap and
-        # always informative
-        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15)
+        # profiler overhead bounds), cfg13 (fabric-vs-broadcast fan-out),
+        # cfg15 (autotune-vs-static shifting regime) and cfg16
+        # (coalesced-vs-legacy egress) are cheap and always informative
+        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16)
                 or args.full or on_tpu)
 
     failures = {}
@@ -2533,6 +2737,12 @@ def main():
 
         guarded("cfg15_autotune_paired", cfg15)
 
+    if want(16):
+        def cfg16():
+            return run_egress_config("cfg16_egress_paired", rng, reduced)
+
+        guarded("cfg16_egress_paired", cfg16)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -2546,11 +2756,38 @@ def main():
     fabric_res = results.pop("cfg13_fabric_paired", None)
     hostprof_res = results.pop("cfg14_hostprof_overhead", None)
     autotune_res = results.pop("cfg15_autotune_paired", None)
+    egress_res = results.pop("cfg16_egress_paired", None)
+    if (not results and egress_res is not None and autotune_res is None
+            and hostprof_res is None and fabric_res is None
+            and devprof_res is None and smallbatch_res is None
+            and failover_res is None and churn_res is None
+            and overload_res is None and tele_res is None
+            and cache_res is None):
+        # a --config 16 run: its own artifact shape; the ≥5x send-syscall
+        # reduction AND ≥1.25x goodput bounds FAIL the run (exit 1) so CI
+        # can gate on the coalesced data plane
+        print(json.dumps({
+            "metric": "egress_syscall_reduction[cfg16_egress_paired]",
+            "value": egress_res["syscall_reduction_x"],
+            "unit": "x_fewer_send_calls_per_msg",
+            "vs_baseline": egress_res["syscall_reduction_x"],
+            "ok": egress_res["ok"],
+            "goodput_ratio": egress_res["goodput_ratio"],
+            "syscalls_per_msg_coalesced":
+                egress_res["syscalls_per_msg_coalesced"],
+            "platform": platform,
+            "egress_paired": egress_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not egress_res["ok"]:
+            sys.exit(1)
+        return
     if (not results and autotune_res is not None and hostprof_res is None
             and fabric_res is None and devprof_res is None
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
-            and tele_res is None and cache_res is None):
+            and tele_res is None and cache_res is None
+            and egress_res is None):
         # a --config 15 run: its own artifact shape; the ≥1.15x
         # autotune-over-static bound (plus ≥1 adaptation and 0 unrecovered
         # rollbacks) FAILS the run (exit 1) so CI can gate on it
@@ -2573,7 +2810,7 @@ def main():
             and devprof_res is None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None):
+            and cache_res is None and egress_res is None):
         # a --config 14 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI can gate on the host-profiler cost
         print(json.dumps({
@@ -2593,7 +2830,7 @@ def main():
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
-            and hostprof_res is None):
+            and hostprof_res is None and egress_res is None):
         # a --config 13 run: its own artifact shape; the ≥3× cross-worker
         # fan-out bound FAILS the run (exit 1) so CI can gate on it
         print(json.dumps({
@@ -2618,7 +2855,7 @@ def main():
     if (not results and devprof_res is not None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None):
+            and cache_res is None and egress_res is None):
         # a --config 12 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI and the chip hunter can gate on it
         print(json.dumps({
@@ -2637,7 +2874,8 @@ def main():
         return
     if (not results and smallbatch_res is not None and failover_res is None
             and churn_res is None and overload_res is None
-            and tele_res is None and cache_res is None):
+            and tele_res is None and cache_res is None
+            and egress_res is None):
         # a --config 11 run (chip hunter window): its own artifact shape
         print(json.dumps({
             "metric": "smallbatch_fused_pair_ratio[cfg11_smallbatch_paired]",
@@ -2652,7 +2890,8 @@ def main():
         }))
         return
     if (not results and failover_res is not None and churn_res is None
-            and overload_res is None and tele_res is None and cache_res is None):
+            and overload_res is None and tele_res is None
+            and cache_res is None and egress_res is None):
         sb = failover_res["time_to_switchback_s"]
         no_sb = sb is None
         if no_sb:
@@ -2677,7 +2916,8 @@ def main():
         }))
         return
     if (not results and churn_res is not None and overload_res is None
-            and tele_res is None and cache_res is None):
+            and tele_res is None and cache_res is None
+            and egress_res is None):
         print(json.dumps({
             "metric": "delta_upload_reduction[cfg9_churn_soak]",
             "value": churn_res["delta_reduction_x"],
@@ -2692,7 +2932,8 @@ def main():
             **({"failed_configs": failures} if failures else {}),
         }))
         return
-    if not results and overload_res is not None and tele_res is None and cache_res is None:
+    if (not results and overload_res is not None and tele_res is None
+            and cache_res is None and egress_res is None):
         print(json.dumps({
             "metric": "overload_p99_bound[cfg8_overload_soak]",
             "value": overload_res["p99_ratio_off_over_on"],
@@ -2705,7 +2946,8 @@ def main():
             **({"failed_configs": failures} if failures else {}),
         }))
         return
-    if not results and tele_res is not None and cache_res is None:
+    if (not results and tele_res is not None and cache_res is None
+            and egress_res is None):
         print(json.dumps({
             "metric": "telemetry_overhead_pct[cfg7_telemetry_overhead]",
             "value": tele_res["overhead_pct"],
@@ -2719,7 +2961,7 @@ def main():
             **({"failed_configs": failures} if failures else {}),
         }))
         return
-    if not results and cache_res is not None:
+    if not results and cache_res is not None and egress_res is None:
         print(json.dumps({
             "metric": "route_cache_speedup[cfg6_cache_zipf]",
             "value": cache_res["zipf"]["speedup_cached"],
@@ -2844,6 +3086,10 @@ def main():
         # (broker/autotune.py)
         **({"autotune_paired": autotune_res}
            if autotune_res is not None else {}),
+        # coalesced-egress paired estimator (cfg16): send-syscalls per
+        # delivered message + fan-out goodput, coalesced vs legacy
+        # per-frame writes (broker/egress.py)
+        **({"egress_paired": egress_res} if egress_res is not None else {}),
         **devprof_embed,
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),

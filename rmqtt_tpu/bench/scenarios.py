@@ -1638,6 +1638,220 @@ _profile(Profile(
 ))
 
 
+# ------------------------------------------- sharded accept (connect storm)
+async def run_connect_storm_sharded(profile: Profile, inproc: bool = False,
+                                    workdir: Optional[str] = None) -> dict:
+    """Scenario-matrix runner for ``connect_storm_sharded``: CONNECT
+    waves against M SO_REUSEPORT fabric workers sharing ONE client port
+    (the ``--workers N --fabric`` deployment shape — the kernel
+    load-balances accepts across the worker processes). Each worker gets
+    its OWN admin API port so the report carries per-worker connection
+    gauges — the evidence that the kernel actually sharded the accept
+    load instead of funneling every handshake into one process. A QoS1
+    anchor stream runs through the whole storm and must land every acked
+    publish (zero acked loss across the worker fleet); each wave reports
+    its own CONNECT p50/p99."""
+    if inproc:
+        raise ValueError("sharded accept needs real SO_REUSEPORT worker "
+                         "processes")
+    nworkers, waves, wave_conns = 2, 6, 24
+    report = base_report(profile.name, "subprocess")
+    report["descr"] = profile.descr
+    port = _free_port()
+    api_ports = [_free_port() for _ in range(nworkers)]
+    procs: List[subprocess.Popen] = []
+    held: List[MiniClient] = []
+    clients: List[MiniClient] = []
+    acked: List[bytes] = []
+    stop_traffic = asyncio.Event()
+    traffic: Optional[asyncio.Task] = None
+
+    async def _wait_tcp(p, deadline):
+        while True:
+            try:
+                with socket.create_connection(("127.0.0.1", p), timeout=0.3):
+                    return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"port {p} never opened")
+                await asyncio.sleep(0.15)
+
+    with tempfile.TemporaryDirectory() as td:
+        wd = Path(workdir or td)
+        fdir = wd / "fab"
+        fdir.mkdir(exist_ok=True)
+        try:
+            for wid in range(1, nworkers + 1):
+                conf_path = wd / f"w{wid}.toml"
+                conf_path.write_text(
+                    "[listener]\n"
+                    'host = "127.0.0.1"\n'
+                    f"port = {port}\n"
+                    "reuse_port = true\n\n"
+                    "[http_api]\n"
+                    'host = "127.0.0.1"\n'
+                    f"port = {api_ports[wid - 1]}\n\n"
+                    "[log]\n"
+                    'to = "off"\n')
+                log_f = open(wd / f"w{wid}.log", "ab")
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "rmqtt_tpu.broker",
+                     "--config", str(conf_path), "--node-id", str(wid),
+                     "--fabric", "--fabric-dir", str(fdir),
+                     "--fabric-worker-id", str(wid),
+                     "--fabric-workers", str(nworkers)],
+                    cwd=str(REPO),
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                    stdout=log_f, stderr=log_f))
+                log_f.close()
+            deadline = time.monotonic() + 120.0
+            for p in (port, *api_ports):
+                await _wait_tcp(p, deadline)
+            await asyncio.sleep(1.0)  # workers register over the UDS mesh
+            # ---- QoS1 anchor stream through the whole storm: the
+            # kernel places sub and pub on whatever workers it likes, so
+            # delivery may also cross the fabric mid-storm
+            sub = await MiniClient.connect(port, "css-sub")
+            clients.append(sub)
+            await sub.subscribe("css/t", qos=1)
+            pub = await MiniClient.connect(port, "css-pub")
+            clients.append(pub)
+
+            async def stream():
+                seq = 0
+                while not stop_traffic.is_set():
+                    payload = f"css-{seq}".encode()
+                    try:
+                        await pub.publish("css/t", payload, qos=1)
+                        acked.append(payload)
+                    except (ConnectionError, asyncio.TimeoutError, OSError):
+                        await asyncio.sleep(0.1)
+                    seq += 1
+                    await asyncio.sleep(0.01)
+
+            traffic = asyncio.ensure_future(stream())
+            # ---- the storm: waves of concurrent CONNECTs, every client
+            # HELD OPEN so the final per-worker gauges show placement
+            wave_rows = []
+            t0 = time.monotonic()
+            for w in range(waves):
+                times: List[float] = []
+
+                async def dial(i):
+                    t = time.monotonic()
+                    c = await MiniClient.connect(port, f"css-{w}-{i}")
+                    times.append((time.monotonic() - t) * 1e3)
+                    held.append(c)
+
+                res = await asyncio.gather(
+                    *(dial(i) for i in range(wave_conns)),
+                    return_exceptions=True)
+                fails = sum(1 for r in res if isinstance(r, BaseException))
+                ts = sorted(times)
+                wave_rows.append({
+                    "wave": w + 1, "connects": len(ts), "failures": fails,
+                    "connect_p50_ms":
+                        round(ts[len(ts) // 2], 3) if ts else None,
+                    "connect_p99_ms":
+                        round(ts[min(len(ts) - 1, int(len(ts) * 0.99))], 3)
+                        if ts else None,
+                })
+            storm_s = time.monotonic() - t0
+            # ---- sharding evidence: each worker's own connection gauge
+            per_worker = []
+            for i in range(nworkers):
+                status, body = await _http_json(api_ports[i],
+                                                "/api/v1/stats")
+                if status != 200:
+                    raise RuntimeError(f"worker {i + 1} stats -> {status}")
+                per_worker.append(body[0]["stats"]["connections"])
+            sharded = sum(1 for c in per_worker if c > 0)
+            report["phases"].append({
+                "name": "connect_storm_sharded",
+                "ok": (sharded >= 2
+                       and all(r["failures"] == 0 for r in wave_rows)),
+                "connections": len(held),
+                "seconds": round(storm_s, 3),
+                "handshakes_per_s": (round(len(held) / storm_s, 1)
+                                     if storm_s else 0.0),
+                "waves": wave_rows,
+                "per_worker_connections": per_worker,
+                "workers_accepting": sharded,
+            })
+            # ---- drain: every acked anchor publish reached the sub
+            stop_traffic.set()
+            await traffic
+            traffic = None
+            want = set(acked)
+            got: set = set()
+            deadline = time.monotonic() + 30.0
+            while not want <= got and time.monotonic() < deadline:
+                try:
+                    p = await asyncio.wait_for(sub.publishes.get(), 1.0)
+                    got.add(p.payload)
+                except asyncio.TimeoutError:
+                    pass
+            lost = len(want - got)
+            active_s = time.monotonic() - t0
+            report["phases"].append({
+                "name": "anchor_stream", "ok": lost == 0,
+                "published": len(acked), "delivered": len(want & got),
+                "lost": lost, "seconds": round(active_s, 3)})
+            report["goodput"] = {
+                "published": len(acked), "delivered": len(want & got),
+                "phase_seconds": round(active_s, 3),
+                "delivered_per_s": (round(len(want & got) / active_s, 1)
+                                    if active_s else 0.0),
+            }
+            report["connect_storm"] = {
+                "workers": nworkers,
+                "waves": wave_rows,
+                "per_worker_connections": per_worker,
+                "workers_accepting": sharded,
+                "handshakes_per_s": (round(len(held) / storm_s, 1)
+                                     if storm_s else 0.0),
+            }
+        except Exception as e:
+            report["errors"].append(f"{type(e).__name__}: {e}")
+        finally:
+            stop_traffic.set()
+            if traffic is not None:
+                traffic.cancel()
+                try:
+                    await traffic
+                except (asyncio.CancelledError, Exception):
+                    pass
+            for c in [*clients, *held]:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+    report["slo"] = {"state": None, "objectives": []}
+    ok = (not report["errors"]
+          and all(p.get("ok") for p in report["phases"]))
+    return finish_report(report, ok)
+
+
+_profile(Profile(
+    name="connect_storm_sharded",
+    descr="CONNECT waves against SO_REUSEPORT fabric workers sharing one "
+          "client port: per-wave CONNECT p99, per-worker accept counts "
+          "(kernel sharding evidence), QoS1 anchor stream with zero acked "
+          "loss across the storm",
+    steps=(),
+    subprocess_only=True,
+    runner=run_connect_storm_sharded,
+))
+
+
 #: tier-1 wiring (tests/test_slo.py), chaos_matrix.FAST_SUBSET-style
 FAST_SUBSET = ["smoke_fast"]
 

@@ -375,11 +375,29 @@ class MqttCodec:
                 body += encode_properties(p.properties)
             return self._frame(pk.TYPE_CONNACK, 0, body)
         if isinstance(p, Publish):
+            if p.qos and p.packet_id is None:
+                raise ProtocolError("QoS>0 PUBLISH needs packet_id")
+            # C++ fast path (runtime/codec.cc rt_codec_encode_publish):
+            # the whole frame — header byte, varint, topic, packet id,
+            # props blob, payload — is assembled in one native call. Byte
+            # equality with the Python arm below is property-tested; only
+            # engage above the same crossover the scanner uses (the ctypes
+            # marshalling costs more than small frames save)
+            if len(p.payload) >= NATIVE_MIN_BYTES:
+                lib = _native_lib()
+                topic_b = p.topic.encode("utf-8")
+                if lib is not None and len(topic_b) <= 0xFFFF:
+                    from rmqtt_tpu.runtime import codec_encode_publish
+
+                    data = codec_encode_publish(
+                        lib, topic_b, bytes(p.payload),
+                        encode_properties(p.properties) if v5 else b"",
+                        p.qos, p.retain, p.dup, p.packet_id)
+                    if data is not None:
+                        return data
             flags = (0x8 if p.dup else 0) | ((p.qos & 0x3) << 1) | (0x1 if p.retain else 0)
             body = bytearray(encode_utf8(p.topic))
             if p.qos:
-                if p.packet_id is None:
-                    raise ProtocolError("QoS>0 PUBLISH needs packet_id")
                 body += p.packet_id.to_bytes(2, "big")
             if v5:
                 body += encode_properties(p.properties)

@@ -10,6 +10,7 @@ connection handler receives — the extension-manager seam
 from __future__ import annotations
 
 import asyncio
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -260,6 +261,18 @@ class BrokerConfig:
     # group commit (the durability contract), "normal" trades crash
     # windows for throughput (redis durability is appendfsync policy)
     durability_sync: str = "full"
+    # syscall-batched data plane (broker/egress.py, [network] conf
+    # section): per-connection egress coalescing — every frame queued for
+    # a socket within one loop tick joins a single vectored send instead
+    # of one write per frame — and the hashed keepalive timer wheel (one
+    # ticking task per worker instead of one timer per connection).
+    # RMQTT_EGRESS_COALESCE=0 / RMQTT_KEEPALIVE_WHEEL=0 are operator
+    # kill-switches the TOML knobs cannot override (AND-composed, the
+    # RMQTT_DELTA_UPLOADS discipline).
+    egress_coalesce: bool = True
+    egress_high_water: int = 64 * 1024  # flush+drain past this many bytes
+    keepalive_wheel: bool = True
+    keepalive_wheel_tick: float = 1.0  # wheel resolution (seconds/slot)
     # [failpoints] conf section (utils/failpoints.py): site name → action
     # spec ("off | error | delay(ms) | hang | prob(p, act) | times(n, act)");
     # RMQTT_FAILPOINTS env entries override these at context construction
@@ -456,6 +469,23 @@ class ServerContext:
         from rmqtt_tpu.broker.slo import SloEngine
 
         self.slo = SloEngine(self, self.cfg)
+        # syscall-batched data plane (broker/egress.py): resolved flags
+        # SessionState reads per connection. The env kill-switches AND
+        # with the TOML knobs — a config file must never silently
+        # re-enable a path an operator killed via env (the
+        # RMQTT_DELTA_UPLOADS discipline above)
+        self.egress_coalesce = (
+            self.cfg.egress_coalesce
+            and os.environ.get("RMQTT_EGRESS_COALESCE", "") != "0")
+        self.egress_high_water = int(self.cfg.egress_high_water)
+        self.keepalive_wheel = None
+        if (self.cfg.keepalive_wheel
+                and os.environ.get("RMQTT_KEEPALIVE_WHEEL", "") != "0"):
+            from rmqtt_tpu.broker.egress import KeepaliveWheel
+
+            self.keepalive_wheel = KeepaliveWheel(
+                self.metrics, self.hooks,
+                tick=self.cfg.keepalive_wheel_tick)
         # failpoints ([failpoints] conf section, utils/failpoints.py):
         # applied here so broker configs reach the process registry; the
         # RMQTT_FAILPOINTS env string is re-applied on top (env outranks
@@ -640,6 +670,8 @@ class ServerContext:
             self._hostprof_started = True
         if self.durability is not None:
             self.durability.start()
+        if self.keepalive_wheel is not None:
+            self.keepalive_wheel.start()
         if self._store_sweep_task is None:
             self._store_sweep_task = asyncio.get_running_loop().create_task(
                 self._store_sweep_loop(), name="store-sweep")
@@ -656,6 +688,8 @@ class ServerContext:
             self._store_sweep_task = None
         if self.durability is not None:
             await self.durability.stop()
+        if self.keepalive_wheel is not None:
+            await self.keepalive_wheel.stop()
         await self.autotune.stop()
         await self.slo.stop()
         await self.overload.stop()
@@ -738,6 +772,18 @@ class ServerContext:
         s.cluster_fence_kicks = self.metrics.get("cluster.fence_kicks")
         s.cluster_anti_entropy_runs = self.metrics.get(
             "cluster.anti_entropy.runs")
+        # syscall-batched data plane gauges (broker/egress.py): how many
+        # frames the coalescer absorbed vs how many vectored writes it
+        # issued (frames/flushes ≈ syscalls saved), plus wheel occupancy
+        s.net_egress_frames = self.metrics.get("net.egress_frames")
+        s.net_egress_flushes = self.metrics.get("net.egress_flushes")
+        s.net_egress_bytes = self.metrics.get("net.egress_bytes")
+        s.net_egress_coalesced = self.metrics.get("net.egress_coalesced")
+        s.net_egress_drains = self.metrics.get("net.egress_drains")
+        wheel = self.keepalive_wheel
+        if wheel is not None:
+            s.net_wheel_sessions = wheel.sessions
+            s.net_wheel_timeouts = wheel.timeouts
         # device-plane profiler gauges (broker/devprof.py): jit registry
         # totals + retrace storms + modeled HBM residency (fleet-summable)
         from rmqtt_tpu.broker.devprof import DEVPROF

@@ -798,6 +798,9 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "device_hbm_modeled_mb",
  "host_loop_laggy_ticks","host_lag_storms","host_blocked_calls",
  "host_gc_pauses","host_gc_pause_ms_total","host_open_fds","host_threads",
+ "net_egress_frames","net_egress_flushes","net_egress_bytes",
+ "net_egress_coalesced","net_egress_drains",
+ "net_wheel_sessions","net_wheel_timeouts",
  "routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
  "routing_device_failures","slo_state","slo_transitions","rss_mb"];

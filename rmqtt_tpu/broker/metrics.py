@@ -198,6 +198,21 @@ class Stats:
         self.cluster_retain_sync_dropped = 0
         self.cluster_fence_kicks = 0
         self.cluster_anti_entropy_runs = 0
+        # syscall-batched data plane gauges (broker/egress.py), filled by
+        # ServerContext.stats(); zeros with the coalescer/wheel disabled
+        # so the surface stays shape-stable. frames = frames absorbed,
+        # flushes = vectored writes issued (frames/flushes ≈ syscall
+        # batching factor), coalesced = frames that shared a flush with an
+        # earlier one, drains = high-water backpressure flushes;
+        # wheel_sessions = connections currently armed on the keepalive
+        # wheel, wheel_timeouts = idle kills the wheel fired
+        self.net_egress_frames = 0
+        self.net_egress_flushes = 0
+        self.net_egress_bytes = 0
+        self.net_egress_coalesced = 0
+        self.net_egress_drains = 0
+        self.net_wheel_sessions = 0
+        self.net_wheel_timeouts = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

@@ -394,12 +394,44 @@ class SessionState:
         # packets a client pipelined behind CONNECT in the same TCP segment
         # (legal without waiting for CONNACK); replayed by _read_loop
         self.early_packets: list = []
+        # coalesced egress (broker/egress.py): one vectored send per loop
+        # tick instead of one write per frame. buffers_until_drain writers
+        # (WsWriter) stay on the legacy path — their transport only
+        # flushes on drain(), which the coalescer's tick flush never calls
+        self._egress = None
+        if (getattr(ctx, "egress_coalesce", False)
+                and not getattr(writer, "buffers_until_drain", False)):
+            from rmqtt_tpu.broker.egress import EgressBuf
+
+            self._egress = EgressBuf(
+                writer, ctx.metrics,
+                high_water=getattr(ctx, "egress_high_water", 64 * 1024))
 
     # ------------------------------------------------------------------ io
     async def send(self, packet) -> None:
         await self.send_raw(self.codec.encode(packet))
 
     async def send_raw(self, data: bytes) -> None:
+        eb = self._egress
+        if eb is not None:
+            # coalesced path: the frame joins the connection's per-tick
+            # vector; one call_soon flush hands everything queued this
+            # tick to the transport as a single vectored write. Past the
+            # high-water mark flush inline and drain — same backpressure
+            # the legacy gate applied, now counting our own pending bytes
+            # too (the transport can't see frames still in the vector).
+            async with self._wlock:
+                eb.feed(data)
+                transport = getattr(self.writer, "transport", None)
+                if transport is None:
+                    eb.flush()
+                    await self.writer.drain()
+                elif (eb.pending_bytes + transport.get_write_buffer_size()
+                      > eb.high_water):
+                    eb.flush()
+                    self.ctx.metrics.inc("net.egress_drains")
+                    await self.writer.drain()
+            return
         async with self._wlock:
             self.writer.write(data)
             # drain only under backpressure: an await per delivered message
@@ -428,8 +460,15 @@ class SessionState:
             asyncio.create_task(self._retry_loop(), name=f"retry:{s.client_id}"),
         ]
         timeout = self.ctx.fitter.keepalive_timeout(s.limits.keepalive)
+        wheel = getattr(self.ctx, "keepalive_wheel", None)
+        wheel_entry = None
         if timeout > 0:
-            tasks.append(asyncio.create_task(self._keepalive_loop(timeout)))
+            if wheel is not None:
+                # hashed timer wheel: one ticking task per worker instead
+                # of one timer coroutine per connection (broker/egress.py)
+                wheel_entry = wheel.arm(self, timeout)
+            else:
+                tasks.append(asyncio.create_task(self._keepalive_loop(timeout)))
         closer = asyncio.create_task(self._closing.wait())
         try:
             done, pending = await asyncio.wait(
@@ -443,6 +482,8 @@ class SessionState:
         finally:
             for t in tasks + [closer]:
                 t.cancel()
+            if wheel_entry is not None:
+                wheel.disarm(wheel_entry)
             try:
                 if self.s.connect_info.protocol == pk.V5 and self._kicked:
                     from rmqtt_tpu.broker.types import RC_SESSION_TAKEN_OVER
@@ -452,6 +493,11 @@ class SessionState:
                     )
             except Exception:
                 pass
+            if self._egress is not None:
+                # push any still-vectored frames (the kicked DISCONNECT
+                # above included) into the transport before close()
+                self._egress.flush()
+                self._egress.close()
             try:
                 self.writer.close()
             except Exception:

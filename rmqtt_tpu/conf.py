@@ -354,6 +354,17 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "submit_deadline_s": ("fabric_submit_deadline_s", float),
         "warm_grace_s": ("fabric_warm_grace_s", float),
     }, broker_kwargs)
+    # [network] — syscall-batched data plane (broker/egress.py): the
+    # per-connection egress coalescer (one vectored send per loop tick)
+    # and the hashed keepalive timer wheel (one ticking task per worker).
+    # RMQTT_EGRESS_COALESCE=0 / RMQTT_KEEPALIVE_WHEEL=0 env kill-switches
+    # outrank these knobs (AND-composed in ServerContext).
+    _apply_section(tree, "network", {
+        "egress_coalesce": ("egress_coalesce", bool),
+        "egress_high_water": ("egress_high_water", int),
+        "keepalive_wheel": ("keepalive_wheel", bool),
+        "keepalive_wheel_tick": ("keepalive_wheel_tick", float),
+    }, broker_kwargs)
     # [durability] — crash-safe durability plane (broker/durability.py):
     # group-committed journal of retained/session/subscription/inflight
     # state + cold-start recovery. Default off (zero behavior change).

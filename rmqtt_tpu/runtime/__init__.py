@@ -121,6 +121,15 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
     ]
     lib.rt_codec_scan.restype = ctypes.c_int64
+    if hasattr(lib, "rt_codec_encode_publish"):  # absent in stale .so builds
+        lib.rt_codec_encode_publish.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.rt_codec_encode_publish.restype = ctypes.c_int64
     lib.rt_topic_validate.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
     lib.rt_topic_validate.restype = ctypes.c_int
     _lib = lib
@@ -143,6 +152,27 @@ def codec_scan(lib, buf: bytes, is_v5: bool, max_size: int):
         ctypes.byref(consumed), ctypes.byref(err),
     )
     return meta[:n].tolist(), consumed.value, err.value, n == cap
+
+
+def codec_encode_publish(lib, topic: bytes, payload: bytes, props: bytes,
+                         qos: int, retain: bool, dup: bool,
+                         packet_id: Optional[int]) -> Optional[bytes]:
+    """Assemble one complete PUBLISH wire frame in C++ (codec.cc). `props`
+    is the pre-encoded v5 properties blob (varint prefix + content; empty
+    for v3). None when the .so predates the symbol (stale prebuilt build)
+    — the caller falls back to the Python encoder."""
+    if not hasattr(lib, "rt_codec_encode_publish"):
+        return None
+    cap = 7 + len(topic) + len(props) + len(payload) + (2 if qos else 0)
+    out = (ctypes.c_uint8 * cap)()
+    n = lib.rt_codec_encode_publish(
+        topic, len(topic), payload, len(payload), props, len(props),
+        qos, 1 if retain else 0, 1 if dup else 0,
+        -1 if packet_id is None else packet_id, out, cap,
+    )
+    if n < 0:
+        return None  # cap miscount — let the Python path handle it
+    return bytes(out[:n])
 
 
 def topic_validate(topic: str, is_filter: bool) -> Optional[bool]:

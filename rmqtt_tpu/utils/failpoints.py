@@ -73,6 +73,8 @@ SITES: List[Tuple[str, str]] = [
     ("fabric.submit", "intra-node fabric publish submission to the router "
                       "owner (failure degrades to worker-local match)"),
     ("bridge.egress", "bridge producer sends (kafka/pulsar/nats egress pumps)"),
+    ("net.egress", "per-connection coalesced egress flush (the vectored "
+                   "write; error = connection drops, its read loop reaps it)"),
 ]
 
 

@@ -155,6 +155,55 @@ int64_t rt_codec_scan(const uint8_t* buf, int64_t len, int32_t is_v5,
   return n;
 }
 
+// Assemble one complete PUBLISH wire frame (the broker's hot outbound
+// type): fixed header byte (flags from dup/qos/retain), remaining-length
+// varint, 2-byte topic length + topic, optional packet id (qos > 0;
+// packet_id < 0 = none), the caller's pre-encoded v5 properties blob
+// (varint length prefix + content; zero-length for v3), payload. Byte
+// layout matches MqttCodec.encode's Publish arm exactly — the Python
+// path stays the oracle (tests pin byte equality).
+//
+// Returns the frame length, or -1 when `cap` can't hold it (caller
+// retries on the Python path; never a partial write into `out`).
+int64_t rt_codec_encode_publish(const uint8_t* topic, int64_t topic_len,
+                                const uint8_t* payload, int64_t payload_len,
+                                const uint8_t* props, int64_t props_len,
+                                int32_t qos, int32_t retain, int32_t dup,
+                                int32_t packet_id, uint8_t* out,
+                                int64_t cap) {
+  int64_t body = 2 + topic_len + (qos > 0 ? 2 : 0) + props_len + payload_len;
+  // remaining-length varint size (1..4 bytes; 268435455 is the MQTT max)
+  int vlen = body < 128 ? 1 : body < 16384 ? 2 : body < 2097152 ? 3 : 4;
+  const int64_t total = 1 + vlen + body;
+  if (total > cap || body > 268435455) return -1;
+  uint8_t* w = out;
+  *w++ = static_cast<uint8_t>((3 << 4) | (dup ? 0x8 : 0) |
+                              ((qos & 0x3) << 1) | (retain ? 0x1 : 0));
+  int64_t rem = body;
+  do {
+    uint8_t b = rem & 0x7F;
+    rem >>= 7;
+    *w++ = rem ? (b | 0x80) : b;
+  } while (rem);
+  *w++ = static_cast<uint8_t>(topic_len >> 8);
+  *w++ = static_cast<uint8_t>(topic_len & 0xFF);
+  std::memcpy(w, topic, topic_len);
+  w += topic_len;
+  if (qos > 0) {
+    *w++ = static_cast<uint8_t>((packet_id >> 8) & 0xFF);
+    *w++ = static_cast<uint8_t>(packet_id & 0xFF);
+  }
+  if (props_len > 0) {
+    std::memcpy(w, props, props_len);
+    w += props_len;
+  }
+  if (payload_len > 0) {
+    std::memcpy(w, payload, payload_len);
+    w += payload_len;
+  }
+  return total;
+}
+
 // Topic / topic-filter validation (core/topic.py topic_valid/filter_valid,
 // reference topic.rs Topic::is_valid). Levels split on '/'; UTF-8 passes
 // through untouched ('+'/'#'/'$' are ASCII, safe to scan bytewise).

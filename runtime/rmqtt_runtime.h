@@ -40,10 +40,15 @@ int64_t rt_match_decode_routes(const uint32_t* routes, int64_t n,
                                int32_t chunk, const int64_t* fid_map,
                                int64_t* out_fids);
 
-// codec.cc — MQTT frame scanner + topic validation
+// codec.cc — MQTT frame scanner + PUBLISH frame assembler + topic validation
 int64_t rt_codec_scan(const uint8_t* buf, int64_t len, int32_t is_v5,
                       int64_t max_size, int64_t* meta, int64_t cap,
                       int64_t* consumed, int32_t* err);
+int64_t rt_codec_encode_publish(const uint8_t* topic, int64_t topic_len,
+                                const uint8_t* payload, int64_t payload_len,
+                                const uint8_t* props, int64_t props_len,
+                                int32_t qos, int32_t retain, int32_t dup,
+                                int32_t packet_id, uint8_t* out, int64_t cap);
 int rt_topic_validate(const uint8_t* s, int64_t len, int is_filter);
 
 }  // extern "C"

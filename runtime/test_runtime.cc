@@ -174,6 +174,28 @@ static void test_codec() {
   nf = rt_codec_scan(trunc.data(), (int64_t)trunc.size(), 0, 1 << 20, meta, 4,
                      &consumed, &err);
   assert(err == 4);
+  // encode_publish round-trip: assemble the same v5 qos1 frame the scan
+  // above parsed and compare byte-for-byte
+  uint8_t frame[64];
+  const uint8_t props0[] = {0x00};  // v5 empty props (varint 0)
+  int64_t fl = rt_codec_encode_publish(
+      (const uint8_t*)"a/b", 3, (const uint8_t*)"hi", 2, props0, 1,
+      /*qos=*/1, /*retain=*/0, /*dup=*/0, /*packet_id=*/7, frame, 64);
+  assert(fl == 12);
+  assert(std::memcmp(frame, buf.data() + 4, 12) == 0);
+  // v3 qos0 retained (no packet id, no props), empty payload
+  fl = rt_codec_encode_publish((const uint8_t*)"t", 1, nullptr, 0, nullptr,
+                               0, 0, 1, 0, -1, frame, 64);
+  assert(fl == 5 && frame[0] == 0x31 && frame[1] == 3);
+  // multi-byte remaining-length varint (200-byte payload → rem = 203)
+  std::vector<uint8_t> big(200, 0xAB);
+  fl = rt_codec_encode_publish((const uint8_t*)"t", 1, big.data(), 200,
+                               nullptr, 0, 0, 0, 0, -1, frame, 64);
+  assert(fl == -1);  // cap too small: refused, nothing written
+  std::vector<uint8_t> out2(256);
+  fl = rt_codec_encode_publish((const uint8_t*)"t", 1, big.data(), 200,
+                               nullptr, 0, 0, 0, 0, -1, out2.data(), 256);
+  assert(fl == 206 && out2[1] == 0xCB && out2[2] == 0x01);  // varint 203
   // validation edge cases
   assert(rt_topic_validate((const uint8_t*)"a/b", 3, 0) == 1);
   assert(rt_topic_validate((const uint8_t*)"a/+", 3, 0) == 0);

@@ -89,7 +89,8 @@ def parse_round(path: str) -> Optional[dict]:
         # a round is trendable with a configs table OR a special-shape
         # block we synthesize a config entry from (cfg15 standalone runs)
         return isinstance(b, dict) and bool(
-            b.get("configs") or b.get("autotune_paired"))
+            b.get("configs") or b.get("autotune_paired")
+            or b.get("egress_paired"))
 
     body = art.get("parsed")
     if not usable(body):
@@ -139,6 +140,16 @@ def parse_round(path: str) -> Optional[dict]:
             "p99_ms": ap["autotune"].get("p99_small_ms"),
             "speedup": ap.get("pair_ratio"),
             **({"reduced_sizes": True} if ap.get("reduced_sizes") else {}),
+        })
+    # cfg16: the coalesced leg's fan-out goodput is the tracked number,
+    # the coalesced-over-legacy goodput ratio rides as "speedup"
+    ep = body.get("egress_paired")
+    if isinstance(ep, dict):
+        body_configs.setdefault("cfg16_egress_paired", {
+            "tpu_topics_per_sec": ep.get("fanout_goodput_coalesced"),
+            "speedup": ep.get("goodput_ratio"),
+            "syscall_reduction_x": ep.get("syscall_reduction_x"),
+            **({"reduced_sizes": True} if ep.get("reduced_sizes") else {}),
         })
     configs = {}
     for name, entry in body_configs.items():

@@ -518,6 +518,40 @@ async def cell_bridge(site: str, action: str) -> dict:
         await remote.stop()
 
 
+async def cell_net_egress(site: str, action: str) -> dict:
+    """net.egress: an injected flush error drops exactly the connection
+    whose vectored write failed (partial frames are never retried — the
+    stream would desync); the client reconnects and delivery resumes."""
+    b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+    await b.start()
+    fp = FAILPOINTS.point(site)
+    base = fp.triggers
+    try:
+        sub = await TestClient.connect(b.port, "cm-sub")
+        await sub.subscribe("ne/#", qos=0)
+        pub = await TestClient.connect(b.port, "cm-pub")
+        await pub.publish("ne/warm", b"w", qos=0)
+        assert (await sub.recv(timeout=10.0)).payload == b"w"
+        # QoS0 from here: the only outbound frames while armed are the
+        # subscriber's deliveries, so times(1, error) hits ITS flush
+        FAILPOINTS.set(site, action)
+        await pub.publish("ne/hit", b"h", qos=0)
+        await asyncio.wait_for(sub.closed.wait(), timeout=10.0)
+        FAILPOINTS.set(site, "off")
+        sub2 = await TestClient.connect(b.port, "cm-sub")
+        await sub2.subscribe("ne/#", qos=0)
+        await pub.publish("ne/after", b"a", qos=0)
+        p = await sub2.recv(timeout=10.0)
+        frames = b.ctx.metrics.get("net.egress_frames")
+        return {"ok": (p.payload == b"a" and fp.triggers > base
+                       and frames > 0),
+                "triggers": fp.triggers - base,
+                "egress_frames": frames}
+    finally:
+        FAILPOINTS.clear_all()
+        await b.stop()
+
+
 #: the matrix: every registered site fired at least once under live traffic
 MATRIX = {
     "device.dispatch:error": lambda: cell_device("device.dispatch", "times(3, error)"),
@@ -535,6 +569,8 @@ MATRIX = {
     "storage.fsync:error": lambda: cell_durability_fsync(
         "storage.fsync", "times(2, error)"),
     "storage.torn_write:crash_torture": cell_durability_crash,
+    "net.egress:error": lambda: cell_net_egress("net.egress",
+                                                "times(1, error)"),
 }
 
 #: tier-1 subset (fast cells — mostly in-proc; the torn-write torture
@@ -543,7 +579,7 @@ MATRIX = {
 FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
                "bridge.egress:error", "cluster.rpc:partition",
                "fabric.submit:error", "storage.fsync:error",
-               "storage.torn_write:crash_torture"]
+               "storage.torn_write:crash_torture", "net.egress:error"]
 
 
 async def run_matrix(cells=None) -> dict:

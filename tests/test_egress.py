@@ -1,0 +1,507 @@
+"""Syscall-batched data plane (broker/egress.py): coalesced egress,
+keepalive timer wheel, native PUBLISH encode.
+
+The coalescer is default-ON and claims zero behavior change at the
+protocol level, so the load-bearing pins here are the *identity* ones:
+byte-identical frames in enqueue order (acks can never reorder ahead of
+the PUBLISH they follow — one FIFO vector serves the connection), the
+`RMQTT_EGRESS_COALESCE=0` / `[network]` kill-switch restoring the exact
+legacy byte stream, the slow-consumer drain gate still engaging, and
+`buffers_until_drain` writers (WsWriter) bypassing the coalescer so
+their flush-on-drain contract holds. The timer wheel must preserve
+keepalive *semantics* (idle eviction, traffic re-arms, v5
+server-keep-alive override) while collapsing task count to O(1) per
+worker."""
+
+import asyncio
+
+import pytest
+
+from rmqtt_tpu.broker.codec import MqttCodec, packets as pk, props as P
+from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+from rmqtt_tpu.broker.egress import EgressBuf, KeepaliveWheel
+from rmqtt_tpu.broker.metrics import Metrics
+from rmqtt_tpu.broker.server import MqttBroker
+
+from tests.mqtt_client import TestClient
+
+
+def run_async(fn, timeout=30.0):
+    asyncio.run(asyncio.wait_for(fn(), timeout=timeout))
+
+
+# ------------------------------------------------------------ EgressBuf
+
+
+class _RecWriter:
+    """Transport-shaped recorder: every write/writelines call logged."""
+
+    def __init__(self):
+        self.calls = []  # ("write"|"writelines", bytes)
+        self.closed = False
+
+    def write(self, data):
+        self.calls.append(("write", bytes(data)))
+
+    def writelines(self, vec):
+        self.calls.append(("writelines", b"".join(vec)))
+
+    def close(self):
+        self.closed = True
+
+
+def test_egress_ordering_oracle_across_ticks():
+    """Frames come out byte-identical and in enqueue order, however the
+    tick boundaries fall — including ack frames queued behind their
+    PUBLISH (the no-reorder guarantee is FIFO of one shared vector)."""
+
+    async def run():
+        w = _RecWriter()
+        m = Metrics()
+        eb = EgressBuf(w, m)
+        frames = [b"PUB|%d|" % i + bytes([i]) * i for i in range(1, 40)]
+        frames.append(b"PUBACK|1")  # ack behind its publish
+        for i, f in enumerate(frames):
+            eb.feed(f)
+            if i % 7 == 6:  # let the scheduled tick flush run mid-stream
+                await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        got = b"".join(data for _, data in w.calls)
+        assert got == b"".join(frames), "bytes or order changed"
+        # multi-frame ticks went through ONE vectored call each
+        assert any(kind == "writelines" for kind, _ in w.calls)
+        assert m.get("net.egress_frames") == len(frames)
+        assert m.get("net.egress_flushes") == len(w.calls)
+        assert m.get("net.egress_bytes") == len(got)
+        assert m.get("net.egress_coalesced") == len(frames) - len(w.calls)
+
+    run_async(run)
+
+
+def test_egress_flush_failure_closes_writer():
+    """A failed vectored write may have left a partial frame on the wire:
+    the buf must close the writer (read loop reaps the session), never
+    retry — a retried tail would desync the stream."""
+
+    async def run():
+        class _Boom(_RecWriter):
+            def writelines(self, vec):
+                raise ConnectionResetError
+
+        w = _Boom()
+        eb = EgressBuf(w, Metrics())
+        eb.feed(b"a")
+        eb.feed(b"b")
+        eb.flush()
+        assert w.closed, "flush failure must close the writer"
+        eb.feed(b"c")
+        eb.flush()
+        assert all(kind != "write" for kind, _ in w.calls), \
+            "no write may follow a failed flush"
+
+    run_async(run)
+
+
+async def _read_frame(reader) -> bytes:
+    """One whole MQTT frame, raw: fixed header byte + varint + body."""
+    raw = await reader.readexactly(1)
+    length, shift = 0, 0
+    while True:
+        b = await reader.readexactly(1)
+        raw += b
+        length |= (b[0] & 0x7F) << shift
+        shift += 7
+        if not b[0] & 0x80:
+            break
+    return raw + (await reader.readexactly(length) if length else b"")
+
+
+async def _raw_sub_stream(port, cid, topic, n_expect):
+    """Raw-socket subscriber: returns the exact broker→client byte
+    stream after SUBACK, once ``n_expect`` PUBLISH frames arrived."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    codec = MqttCodec(pk.V311)
+    writer.write(codec.encode(pk.Connect(client_id=cid)))
+    writer.write(codec.encode(pk.Subscribe(1, [(topic, pk.SubOpts(qos=0))])))
+    await writer.drain()
+    await _read_frame(reader)  # CONNACK
+    await _read_frame(reader)  # SUBACK
+    stream = b""
+    decode = MqttCodec(pk.V311)
+    seen = 0
+    while seen < n_expect:
+        chunk = await reader.read(65536)
+        assert chunk, "subscriber stream closed early"
+        stream += chunk
+        seen += len(decode.feed(chunk))
+    writer.close()
+    return stream
+
+
+def test_coalesce_kill_switch_byte_identical():
+    """The same publish sequence produces the byte-identical subscriber
+    stream with the coalescer on (default) and off (`egress_coalesce`
+    false — the `RMQTT_EGRESS_COALESCE=0` path resolves into the same
+    ctx flag, pinned in test_kill_switch_env_overrides_conf below)."""
+
+    async def leg(coalesce):
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, egress_coalesce=coalesce)))
+        await b.start()
+        try:
+            task = asyncio.create_task(
+                _raw_sub_stream(b.port, "ks-sub", "ks/t", 20))
+            await asyncio.sleep(0.3)  # SUBSCRIBE lands before publishes
+            c = await TestClient.connect(b.port, "ks-pub")
+            for i in range(20):
+                await c.publish("ks/t", b"payload-%03d" % i, qos=0,
+                                wait_ack=False)
+            stream = await asyncio.wait_for(task, 10.0)
+            await c.disconnect_clean()
+            return stream
+        finally:
+            await b.stop()
+
+    async def run():
+        on = await leg(True)
+        off = await leg(False)
+        assert on == off, "coalescer changed the wire bytes"
+
+    run_async(run)
+
+
+def test_kill_switch_env_overrides_conf(monkeypatch):
+    """RMQTT_EGRESS_COALESCE=0 / RMQTT_KEEPALIVE_WHEEL=0 AND with the
+    TOML knobs: a config file can never re-enable a path the operator
+    killed via env."""
+    monkeypatch.setenv("RMQTT_EGRESS_COALESCE", "0")
+    monkeypatch.setenv("RMQTT_KEEPALIVE_WHEEL", "0")
+    ctx = ServerContext(BrokerConfig(egress_coalesce=True,
+                                     keepalive_wheel=True))
+    assert ctx.egress_coalesce is False
+    assert ctx.keepalive_wheel is None
+    monkeypatch.delenv("RMQTT_EGRESS_COALESCE")
+    monkeypatch.delenv("RMQTT_KEEPALIVE_WHEEL")
+    ctx = ServerContext(BrokerConfig())
+    assert ctx.egress_coalesce is True
+    assert ctx.keepalive_wheel is not None
+
+
+def test_qos12_ack_flow_ordered_under_coalescer():
+    """QoS1/2 control frames share the subscriber's coalesced vector with
+    its PUBLISH deliveries: the full exactly-once flow must complete and
+    payload order must hold across flush ticks."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        try:
+            sub = await TestClient.connect(b.port, "ord-sub")
+            await sub.subscribe("ord/t", qos=2)
+            pub = await TestClient.connect(b.port, "ord-pub")
+            n = 30
+            for i in range(n):
+                await pub.publish("ord/t", b"s%04d" % i, qos=2)
+            got = [await sub.recv(timeout=10.0) for _ in range(n)]
+            assert [p.payload for p in got] == [b"s%04d" % i
+                                               for i in range(n)]
+            assert all(p.qos == 2 for p in got)
+            await sub.expect_nothing()  # exactly once
+            await sub.disconnect_clean()
+            await pub.disconnect_clean()
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+def test_slow_consumer_still_drains():
+    """Regression for the send_raw high-water gate: the coalescer counts
+    its own pending bytes plus the transport buffer, so a subscriber
+    that stops reading still pushes the deliver loop into flush+drain()
+    (slow-consumer backpressure) instead of buffering without bound."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, egress_high_water=2048)))
+        await b.start()
+        try:
+            codec = MqttCodec(pk.V311)
+            reader, writer = await asyncio.open_connection("127.0.0.1", b.port)
+            writer.write(codec.encode(pk.Connect(client_id="slow-sub")))
+            writer.write(codec.encode(
+                pk.Subscribe(1, [("slow/t", pk.SubOpts(qos=0))])))
+            await writer.drain()
+            await reader.read(16)  # CONNACK+SUBACK; then stop reading
+            pub = await TestClient.connect(b.port, "slow-pub")
+            for i in range(128):
+                await pub.publish("slow/t", bytes(4096), qos=0,
+                                  wait_ack=False)
+                if b.ctx.metrics.get("net.egress_drains"):
+                    break
+                await asyncio.sleep(0)
+            await asyncio.sleep(0.3)
+            assert b.ctx.metrics.get("net.egress_drains") > 0, \
+                "slow consumer never hit the drain gate"
+            writer.close()
+            await pub.disconnect_clean()
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+def test_ws_writer_bypasses_coalescer():
+    """WsWriter only flushes its frame buffer on drain(); the coalescer's
+    tick flush never drains, so WS sessions must stay on the legacy
+    per-frame path (and still roundtrip)."""
+    from tests.test_transports import WsTestClient
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0, ws_port=0)))
+        await b.start()
+        try:
+            ws = await WsTestClient.connect(b.ws_port, "ws-bypass")
+            state = b.ctx.registry._sessions["ws-bypass"].state
+            assert state._egress is None, \
+                "buffers_until_drain writer got a coalescer"
+            tcp = await TestClient.connect(b.port, "ws-peer")
+            assert (b.ctx.registry._sessions["ws-peer"].state._egress
+                    is not None), "plain TCP session should coalesce"
+            await ws.send_packet(
+                pk.Subscribe(1, [("wsb/t", pk.SubOpts(qos=0))]))
+            assert isinstance(await ws.recv_packet(), pk.Suback)
+            await tcp.publish("wsb/t", b"over-ws", qos=0, wait_ack=False)
+            p = await asyncio.wait_for(ws.recv_packet(), 5.0)
+            assert isinstance(p, pk.Publish) and p.payload == b"over-ws"
+            await tcp.disconnect_clean()
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+# ------------------------------------------------------- native encode
+
+
+def test_native_encode_matches_python():
+    """Property test: rt_codec_encode_publish (runtime/codec.cc) must be
+    byte-equal to the Python encoder over v3/v5 × qos × retain × dup ×
+    payload sizes straddling the crossover × v5 properties."""
+    import random
+
+    from rmqtt_tpu.broker.codec import codec as codec_mod
+
+    if codec_mod._native_lib() is None:
+        pytest.skip("native runtime unavailable")
+    rng = random.Random(7)
+    sizes = [0, 1, 511, 512, 513, 900, 4096, 70000]
+    for version in (pk.V311, pk.V5):
+        enc = MqttCodec(version)
+        for trial in range(120):
+            qos = rng.randrange(3)
+            props = {}
+            if version == pk.V5 and rng.random() < 0.5:
+                props = {P.CONTENT_TYPE: "x/y",
+                         P.USER_PROPERTY: [("k", "v" * rng.randrange(40))],
+                         P.MESSAGE_EXPIRY_INTERVAL: rng.randrange(1 << 16)}
+            p = pk.Publish(
+                topic="/".join("seg%d" % rng.randrange(9)
+                               for _ in range(rng.randint(1, 6))),
+                payload=bytes(rng.randrange(256)
+                              for _ in range(rng.choice(sizes))),
+                qos=qos, retain=rng.random() < 0.5,
+                dup=qos > 0 and rng.random() < 0.3,
+                packet_id=rng.randrange(1, 65535) if qos else None,
+                properties=props)
+            native = enc.encode(p)
+            saved = codec_mod._native
+            codec_mod._native = False  # force the pure-Python arm
+            try:
+                python = enc.encode(p)
+            finally:
+                codec_mod._native = saved
+            assert native == python, (version, trial, qos, len(p.payload))
+
+
+def test_encode_stale_so_falls_back_to_python():
+    """A prebuilt .so that predates rt_codec_encode_publish must degrade
+    to the Python encoder, not crash (the PR 5 stale-binary rule: every
+    new native symbol is optional at load time)."""
+    from rmqtt_tpu.broker.codec import codec as codec_mod
+    from rmqtt_tpu.runtime import codec_encode_publish
+
+    class _StaleLib:  # no rt_codec_encode_publish attribute
+        pass
+
+    assert codec_encode_publish(_StaleLib(), b"t", b"x" * 600, b"",
+                                0, False, False, None) is None
+    p = pk.Publish(topic="stale/t", payload=b"z" * 1024, qos=1,
+                   packet_id=7, retain=True)
+    enc = MqttCodec(pk.V311)
+    saved = codec_mod._native
+    codec_mod._native = _StaleLib()  # truthy → taken as a loaded lib
+    try:
+        stale = enc.encode(p)
+        codec_mod._native = False
+        python = enc.encode(p)
+    finally:
+        codec_mod._native = saved
+    assert stale == python
+
+
+# ------------------------------------------------------ keepalive wheel
+
+
+class _FakeState:
+    def __init__(self, last_packet):
+        self._last_packet = last_packet
+        self._closing = asyncio.Event()
+        self.s = type("S", (), {"id": None})()
+
+
+class _Hooks:
+    def __init__(self, proceed=True):
+        self.proceed = proceed
+        self.fired = 0
+
+    async def fire(self, *a, **kw):
+        self.fired += 1
+        return self.proceed
+
+
+def test_wheel_fires_idle_refiles_active_rearms_veto():
+    """Wheel unit semantics at fast tick: an idle entry fires the hook
+    and closes; an entry whose ``_last_packet`` advanced re-files at its
+    true deadline without firing; a hook veto re-arms a full timeout."""
+    import time as _time
+
+    async def run():
+        hooks = _Hooks()
+        m = Metrics()
+        wheel = KeepaliveWheel(m, hooks, tick=0.05)
+        wheel.start()
+        try:
+            idle = _FakeState(_time.monotonic())
+            active = _FakeState(_time.monotonic())
+            wheel.arm(idle, 0.2)
+            wheel.arm(active, 0.2)
+            assert wheel.sessions == 2
+            deadline = _time.monotonic() + 5.0  # 1-core CI: generous
+            while not idle._closing.is_set() and _time.monotonic() < deadline:
+                await asyncio.sleep(0.06)
+                active._last_packet = _time.monotonic()
+            assert idle._closing.is_set(), \
+                f"idle entry never fired (ticks={wheel.ticks})"
+            assert not active._closing.is_set(), "active entry fired"
+            assert wheel.sessions == 1
+            assert wheel.timeouts == 1
+            assert m.get("keepalive.timeouts") == 1
+            # veto: the hook says keep it → entry re-arms, nothing closes
+            vhooks = _Hooks(proceed=False)
+            vwheel = KeepaliveWheel(Metrics(), vhooks, tick=0.05)
+            vwheel.start()
+            try:
+                vetoed = _FakeState(_time.monotonic())
+                vwheel.arm(vetoed, 0.15)
+                deadline = _time.monotonic() + 5.0
+                while not vhooks.fired and _time.monotonic() < deadline:
+                    await asyncio.sleep(0.05)
+                assert vhooks.fired >= 1, \
+                    f"veto hook never consulted (ticks={vwheel.ticks})"
+                assert not vetoed._closing.is_set()
+                assert vwheel.sessions == 1, "veto must re-arm the entry"
+                assert vwheel.timeouts == 0
+            finally:
+                await vwheel.stop()
+        finally:
+            await wheel.stop()
+
+    run_async(run)
+
+
+def test_wheel_evicts_idle_keeps_active_o1_tasks():
+    """End-to-end wheel parity with the per-connection timer it replaced:
+    a silent client is evicted at the fitter deadline, a pinging client
+    survives — with ONE wheel task total and zero per-connection
+    keepalive tasks (the O(1) claim)."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+        await b.start()
+        try:
+            assert b.ctx.keepalive_wheel is not None  # default ON
+            idle = await TestClient.connect(b.port, "wheel-idle",
+                                            keepalive=1)
+            live = await TestClient.connect(b.port, "wheel-live",
+                                            keepalive=1)
+            assert b.ctx.keepalive_wheel.sessions == 2
+            names = [t.get_name() for t in asyncio.all_tasks()]
+            assert names.count("keepalive-wheel") == 1
+            assert not any("_keepalive_loop" in repr(t.get_coro())
+                           for t in asyncio.all_tasks()), \
+                "per-connection keepalive task exists despite the wheel"
+
+            async def ping_forever():
+                while True:
+                    await live.ping()
+                    await asyncio.sleep(0.5)
+
+            pinger = asyncio.create_task(ping_forever())
+            # keepalive=1 → fitter timeout 4s (small-value slack)
+            await asyncio.wait_for(idle.closed.wait(), timeout=10.0)
+            pinger.cancel()
+            assert not live.closed.is_set(), "active client was evicted"
+            assert b.ctx.keepalive_wheel.timeouts >= 1
+            assert b.ctx.keepalive_wheel.sessions == 1
+            await live.disconnect_clean()
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+def test_wheel_off_legacy_timer_parity():
+    """`[network] keepalive_wheel=false` restores the per-connection
+    timer path — identical eviction semantics, no wheel constructed."""
+
+    async def run():
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, keepalive_wheel=False)))
+        await b.start()
+        try:
+            assert b.ctx.keepalive_wheel is None
+            c = await TestClient.connect(b.port, "legacy-idle", keepalive=1)
+            await asyncio.wait_for(c.closed.wait(), timeout=10.0)
+        finally:
+            await b.stop()
+
+    run_async(run)
+
+
+def test_wheel_v5_server_keepalive_override():
+    """The v5 server-keep-alive clamp must govern the WHEEL deadline too:
+    the armed timeout follows the announced server value, not the
+    client's requested keepalive (paho test_server_keep_alive, under the
+    wheel)."""
+
+    async def run():
+        from rmqtt_tpu.broker.fitter import FitterConfig
+
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, fitter=FitterConfig(max_keepalive=60))))
+        await b.start()
+        try:
+            c = await TestClient.connect(b.port, "wheel-ska",
+                                         version=pk.V5, keepalive=3600)
+            assert c.connack.properties.get(P.SERVER_KEEP_ALIVE) == 60
+            wheel = b.ctx.keepalive_wheel
+            assert wheel is not None and wheel.sessions == 1
+            entry = next(e for slot in wheel.slots for e in slot)
+            assert entry.timeout == b.ctx.fitter.keepalive_timeout(60)
+            await c.disconnect_clean()
+        finally:
+            await b.stop()
+
+    run_async(run)
