@@ -1559,6 +1559,158 @@ def run_hostprof_overhead_config(name, rng, reduced):
     return res
 
 
+def run_history_overhead_config(name, rng, reduced):
+    """Config 17: telemetry-history collector overhead (broker/history.py)
+    on the REAL publish path, cfg14-style order-symmetric paired estimator.
+
+    One live broker pipe; the history collector is ARMED (periodic
+    cross-plane ``collect_once`` samples + EWMA/MAD anomaly pass —
+    exactly what ``[observability] history`` enables, memory-only like
+    the default ``history_dir=\"\"`` deployment) for the ON bursts and
+    fully stopped for the OFF bursts. The collector runs at a 250 ms
+    cadence here — 20× the 5 s production default — and ``_run``
+    samples at tick START, so every armed window contains at least one
+    real collection and the measured bound is a deliberate upper
+    estimate of the deployed cost. Quads (off,on,on,off) with
+    min-of-two per condition filter one-sided host spikes; the median
+    pair ratio bounds the enabled cost at ≤2% of e2e burst time
+    (standalone ``--config 17`` exits 1 past the bound so CI can gate
+    on it)."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    msgs = 6_000 if reduced else 15_000
+    ntopics = 64
+    payload = b"x" * 64
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _measure():
+        # history=False at construction: the bench owns arm/disarm
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, history_enable=False, allow_anonymous=True)))
+        await b.start()
+        hist = b.ctx.history
+        sr, sw, scodec = await _connect(b.port, "c17-sub")
+        sw.write(scodec.encode(pk.Subscribe(1, [("bench/#", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        await _read_until(sr, scodec, pk.Suback)
+        _pr, pw, pcodec = await _connect(b.port, "c17-pub")
+        frames = [pcodec.encode(pk.Publish(
+            topic=f"bench/t{i}", payload=payload, qos=0))
+            for i in range(ntopics)]
+
+        async def burst(n):
+            t0 = time.perf_counter()
+            sent = got = 0
+            deadline = time.monotonic() + 60.0
+            while sent < n:
+                k = min(64, n - sent)
+                pw.write(b"".join(
+                    frames[(sent + j) % ntopics] for j in range(k)))
+                sent += k
+                if pw.transport.get_write_buffer_size() > 1 << 18:
+                    await pw.drain()
+                while got < sent - 2048:
+                    data = await asyncio.wait_for(
+                        sr.read(1 << 16), deadline - time.monotonic())
+                    if not data:
+                        raise ConnectionError("subscriber closed")
+                    got += sum(1 for p in scodec.feed(data)
+                               if isinstance(p, pk.Publish))
+            await pw.drain()
+            while got < sent:
+                data = await asyncio.wait_for(
+                    sr.read(1 << 16), deadline - time.monotonic())
+                if not data:
+                    raise ConnectionError("subscriber closed")
+                got += sum(1 for p in scodec.feed(data)
+                           if isinstance(p, pk.Publish))
+            return time.perf_counter() - t0
+
+        def arm():
+            hist.enabled = True
+            hist.interval_s = 0.25  # 20× production cadence: upper bound
+            hist.start()
+
+        async def disarm():
+            await hist.stop()
+            hist.enabled = False
+
+        try:
+            await burst(1024)  # warm: codec, cache, deliver path
+            arm()
+            await burst(1024)
+            await disarm()
+            # 512-msg windows: long enough that one collection amortizes
+            # to its steady-state share, short enough for ~15 pairs
+            per = 512
+            pairs = []
+            done = 0
+            while done < msgs:
+                t_off1 = await burst(per)
+                arm()
+                t_on1 = await burst(per)
+                t_on2 = await burst(per)
+                await disarm()
+                t_off2 = await burst(per)
+                pairs.append((min(t_off1, t_off2), min(t_on1, t_on2)))
+                done += 2 * per
+            med_ratio = float(np.median([tn / tf for tf, tn in pairs]))
+            best_off = min(tf for tf, _ in pairs)
+            tele = b.ctx.telemetry
+            lat = {"e2e_p50": tele.p_ms("publish.e2e", 0.50),
+                   "e2e_p99": tele.p_ms("publish.e2e", 0.99)}
+            return per / best_off, med_ratio, lat, len(hist.ring)
+        finally:
+            await hist.stop()
+            hist.enabled = False
+            await b.stop()
+
+    tps_off, med_ratio, lat, samples = asyncio.run(_measure())
+    overhead_pct = round((med_ratio - 1.0) * 100.0, 2)
+    res = {
+        "name": name,
+        "path": "broker_e2e_qos0_pipe",
+        "msgs_per_window": msgs,
+        "msgs_per_sec_off": round(tps_off, 1),
+        "msgs_per_sec_on": round(tps_off / med_ratio, 1),
+        "median_pair_ratio": round(med_ratio, 4),
+        "overhead_pct": overhead_pct,
+        "bound_pct": 2.0,
+        "ok": overhead_pct <= 2.0,
+        # samples actually taken during the armed windows: the ON legs
+        # measured a collector that really fired, not an idle task
+        "samples_recorded": samples,
+        "collector_interval_s": 0.25,
+        "latency_ms": lat,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] history collector OFF {tps_off:.0f} msg/s, median pair "
+        f"ratio {res['median_pair_ratio']}x = {overhead_pct}% overhead "
+        f"(bound 2%, {samples} samples) | e2e p50 {lat['e2e_p50']}ms → "
+        f"{'OK' if res['ok'] else 'FAIL'}")
+    return res
+
+
 def run_failover_config(name, rng, reduced):
     """Config 10: device-plane failover soak (broker/failover.py).
 
@@ -2495,7 +2647,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config 1 only")
     ap.add_argument("--full", action="store_true", help="include 10M-sub configs 4-5")
-    ap.add_argument("--config", type=int, default=None, help="run a single config 1-16")
+    ap.add_argument("--config", type=int, default=None, help="run a single config 1-17")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU (skip TPU probe)")
     ap.add_argument(
@@ -2572,15 +2724,16 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 16
+            return i <= 17
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak), cfg9 (churn soak / delta uploads), cfg11
         # (small-batch stage attribution), cfg12/cfg14 (device/host
         # profiler overhead bounds), cfg13 (fabric-vs-broadcast fan-out),
-        # cfg15 (autotune-vs-static shifting regime) and cfg16
-        # (coalesced-vs-legacy egress) are cheap and always informative
-        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16)
+        # cfg15 (autotune-vs-static shifting regime), cfg16
+        # (coalesced-vs-legacy egress) and cfg17 (history collector
+        # overhead bound) are cheap and always informative
+        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17)
                 or args.full or on_tpu)
 
     failures = {}
@@ -2743,6 +2896,13 @@ def main():
 
         guarded("cfg16_egress_paired", cfg16)
 
+    if want(17):
+        def cfg17():
+            return run_history_overhead_config("cfg17_history_overhead",
+                                               rng, reduced)
+
+        guarded("cfg17_history_overhead", cfg17)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -2757,12 +2917,35 @@ def main():
     hostprof_res = results.pop("cfg14_hostprof_overhead", None)
     autotune_res = results.pop("cfg15_autotune_paired", None)
     egress_res = results.pop("cfg16_egress_paired", None)
+    history_res = results.pop("cfg17_history_overhead", None)
+    if (not results and history_res is not None and egress_res is None
+            and autotune_res is None and hostprof_res is None
+            and fabric_res is None and devprof_res is None
+            and smallbatch_res is None and failover_res is None
+            and churn_res is None and overload_res is None
+            and tele_res is None and cache_res is None):
+        # a --config 17 run: its own artifact shape; the >2% bound FAILS
+        # the run (exit 1) so CI can gate on the history-collector cost
+        print(json.dumps({
+            "metric": "history_overhead_pct[cfg17_history_overhead]",
+            "value": history_res["overhead_pct"],
+            "unit": "pct_vs_off",
+            "vs_baseline": history_res["overhead_pct"],
+            "ok": history_res["ok"],
+            "samples_recorded": history_res["samples_recorded"],
+            "platform": platform,
+            "history_overhead": history_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not history_res["ok"]:
+            sys.exit(1)
+        return
     if (not results and egress_res is not None and autotune_res is None
             and hostprof_res is None and fabric_res is None
             and devprof_res is None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None):
+            and cache_res is None and history_res is None):
         # a --config 16 run: its own artifact shape; the ≥5x send-syscall
         # reduction AND ≥1.25x goodput bounds FAIL the run (exit 1) so CI
         # can gate on the coalesced data plane
@@ -2787,7 +2970,8 @@ def main():
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
-            and egress_res is None):
+            and egress_res is None
+            and history_res is None):
         # a --config 15 run: its own artifact shape; the ≥1.15x
         # autotune-over-static bound (plus ≥1 adaptation and 0 unrecovered
         # rollbacks) FAILS the run (exit 1) so CI can gate on it
@@ -2810,7 +2994,8 @@ def main():
             and devprof_res is None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None and egress_res is None):
+            and cache_res is None and egress_res is None
+            and history_res is None):
         # a --config 14 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI can gate on the host-profiler cost
         print(json.dumps({
@@ -2830,7 +3015,8 @@ def main():
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
-            and hostprof_res is None and egress_res is None):
+            and hostprof_res is None and egress_res is None
+            and history_res is None):
         # a --config 13 run: its own artifact shape; the ≥3× cross-worker
         # fan-out bound FAILS the run (exit 1) so CI can gate on it
         print(json.dumps({
@@ -2855,7 +3041,8 @@ def main():
     if (not results and devprof_res is not None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None and egress_res is None):
+            and cache_res is None and egress_res is None
+            and history_res is None):
         # a --config 12 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI and the chip hunter can gate on it
         print(json.dumps({
@@ -2875,7 +3062,8 @@ def main():
     if (not results and smallbatch_res is not None and failover_res is None
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
-            and egress_res is None):
+            and egress_res is None
+            and history_res is None):
         # a --config 11 run (chip hunter window): its own artifact shape
         print(json.dumps({
             "metric": "smallbatch_fused_pair_ratio[cfg11_smallbatch_paired]",
@@ -2891,7 +3079,8 @@ def main():
         return
     if (not results and failover_res is not None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None and egress_res is None):
+            and cache_res is None and egress_res is None
+            and history_res is None):
         sb = failover_res["time_to_switchback_s"]
         no_sb = sb is None
         if no_sb:
@@ -2917,7 +3106,8 @@ def main():
         return
     if (not results and churn_res is not None and overload_res is None
             and tele_res is None and cache_res is None
-            and egress_res is None):
+            and egress_res is None
+            and history_res is None):
         print(json.dumps({
             "metric": "delta_upload_reduction[cfg9_churn_soak]",
             "value": churn_res["delta_reduction_x"],
@@ -2933,7 +3123,8 @@ def main():
         }))
         return
     if (not results and overload_res is not None and tele_res is None
-            and cache_res is None and egress_res is None):
+            and cache_res is None and egress_res is None
+            and history_res is None):
         print(json.dumps({
             "metric": "overload_p99_bound[cfg8_overload_soak]",
             "value": overload_res["p99_ratio_off_over_on"],
@@ -2947,7 +3138,8 @@ def main():
         }))
         return
     if (not results and tele_res is not None and cache_res is None
-            and egress_res is None):
+            and egress_res is None
+            and history_res is None):
         print(json.dumps({
             "metric": "telemetry_overhead_pct[cfg7_telemetry_overhead]",
             "value": tele_res["overhead_pct"],
@@ -2961,7 +3153,8 @@ def main():
             **({"failed_configs": failures} if failures else {}),
         }))
         return
-    if not results and cache_res is not None and egress_res is None:
+    if (not results and cache_res is not None and egress_res is None
+            and history_res is None):
         print(json.dumps({
             "metric": "route_cache_speedup[cfg6_cache_zipf]",
             "value": cache_res["zipf"]["speedup_cached"],
@@ -2988,6 +3181,11 @@ def main():
         failures["cfg14_hostprof_overhead"] = (
             f"host profiler overhead {hostprof_res['overhead_pct']}% > "
             f"{hostprof_res['bound_pct']}% bound")
+    if history_res is not None and not history_res["ok"]:
+        # same contract for the telemetry-history collector (cfg17)
+        failures["cfg17_history_overhead"] = (
+            f"history collector overhead {history_res['overhead_pct']}% > "
+            f"{history_res['bound_pct']}% bound")
 
     # headline = the largest routing config that ran
     if not results:
@@ -3090,6 +3288,11 @@ def main():
         # delivered message + fan-out goodput, coalesced vs legacy
         # per-frame writes (broker/egress.py)
         **({"egress_paired": egress_res} if egress_res is not None else {}),
+        # history-collector overhead bound (cfg17): armed-vs-stopped cost
+        # of the [observability] history knob at 100× production cadence
+        # (broker/history.py)
+        **({"history_overhead": history_res}
+           if history_res is not None else {}),
         **devprof_embed,
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),

@@ -171,6 +171,26 @@ class BrokerConfig:
     host_block_ms: float = 150.0  # loop-tick gap that counts as blocked
     host_lag_storm_n: int = 8  # laggy ticks within the window = a storm
     host_lag_storm_window: float = 10.0  # seconds
+    # devprof/hostprof rollup-ring retention (same [observability]
+    # section): interval buckets kept per profiler — at the default 5 s
+    # interval, 120 rollups = a 10-minute in-memory window
+    device_rollup_max: int = 120
+    host_rollup_max: int = 120
+    # telemetry-history plane (broker/history.py, same [observability]
+    # section): fixed-interval collector snapshotting every plane into
+    # one sample row, bounded in-memory ring + (history_dir set)
+    # CRC-framed on-disk segments with retention, range queries with
+    # downsampling/cluster merge, and an EWMA+MAD anomaly annotator.
+    # history=false starts no task and keeps every surface shape-stable.
+    history_enable: bool = True
+    history_interval_s: float = 5.0  # seconds between samples
+    history_ring_max: int = 720  # in-memory samples (1 h at 5 s)
+    history_dir: str = ""  # segment directory ("" = memory only)
+    history_segment_rows: int = 2048  # samples per segment before rotate
+    history_retention_segments: int = 16  # on-disk segments kept
+    history_anomaly_enable: bool = True
+    history_anomaly_k: float = 6.0  # breach at k x EWMA deviation
+    history_anomaly_warmup: int = 8  # samples before a series can breach
     # overload-control subsystem (broker/overload.py, [overload] config
     # section): watermark-driven NORMAL/ELEVATED/CRITICAL states, token-
     # bucket admission, degradation tiers, circuit-broken egress. Disabled
@@ -561,6 +581,7 @@ class ServerContext:
             ring=self.cfg.device_ring,
             storm_n=self.cfg.device_storm_n,
             storm_window=self.cfg.device_storm_window,
+            rollup_max=self.cfg.device_rollup_max,
             telemetry=self.telemetry,
             hbm_provider=getattr(router, "device_hbm", None),
         )
@@ -588,9 +609,18 @@ class ServerContext:
             block_ms=self.cfg.host_block_ms,
             lag_storm_n=self.cfg.host_lag_storm_n,
             lag_storm_window=self.cfg.host_lag_storm_window,
+            rollup_max=self.cfg.host_rollup_max,
             telemetry=self.telemetry,
             dispatch_probe=_host_dispatch_probe,
         )
+        # telemetry-history plane (broker/history.py): the cross-plane
+        # timeline collector. Constructed last so its collector sees every
+        # other plane wired; recovery (history_dir set) runs here,
+        # synchronously, so a restarted broker serves its pre-restart
+        # timeline before the first new sample lands.
+        from rmqtt_tpu.broker.history import HistoryService
+
+        self.history = HistoryService(self, self.cfg)
 
     @property
     def handshaking(self) -> int:
@@ -661,6 +691,7 @@ class ServerContext:
         self.overload.start()
         self.slo.start()
         self.autotune.start()  # no-op while [routing] autotune = false
+        self.history.start()  # no-op while [observability] history = false
         # host-plane profiler: refcounted process-global start (a second
         # in-process broker shares the one sampler); no-op when disabled
         from rmqtt_tpu.broker.hostprof import HOSTPROF
@@ -677,6 +708,9 @@ class ServerContext:
                 self._store_sweep_loop(), name="store-sweep")
 
     async def stop(self) -> None:
+        # history first: its collector reads every other plane, so it must
+        # stop (and close its open segment cleanly) before they do
+        await self.history.stop()
         if self.fabric is not None:
             await self.fabric.stop()
         if self._store_sweep_task is not None:
@@ -832,6 +866,13 @@ class ServerContext:
             s.durability_recovered_subs = dur.recovered["subs"]
             s.durability_recovered_inflight = dur.recovered["inflight"]
             s.durability_recovery_ms = dur.recovery_ms
+        # telemetry-history gauges (broker/history.py); zeros while the
+        # collector is disabled so the surface stays shape-stable
+        hist = self.history.snapshot()
+        s.history_samples = hist["samples"]
+        s.history_anomalies = hist["anomalies"]
+        s.history_segments = hist["segments"]
+        s.history_recovered_rows = hist["recovered_rows"]
         # process RSS (utils/sysmon.py — same probe the overload sampler
         # uses); sums to a cluster memory total in /stats/sum
         from rmqtt_tpu.utils.sysmon import rss_mb

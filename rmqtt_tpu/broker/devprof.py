@@ -217,6 +217,11 @@ class DeviceProfiler:
                 self.ring_cap = max(1, int(kw["ring"]))
                 self.flight_ring = deque(self.flight_ring,
                                          maxlen=self.ring_cap)
+            if ("rollup_max" in kw
+                    and max(2, int(kw["rollup_max"])) != self.rollup_max):
+                self.rollup_max = max(2, int(kw["rollup_max"]))
+                self._rollups = deque(self._rollups,
+                                      maxlen=self.rollup_max)
 
     def reset(self) -> None:
         """Drop every counter/ring (tests; the registry is process-global,
@@ -420,6 +425,14 @@ class DeviceProfiler:
         out["warm_p99_ms"] = round(whist.quantile(0.99) / 1e6, 3)
         out["batch_p50"] = int(bhist.quantile(0.50))
         out["batch_p99"] = int(bhist.quantile(0.99))
+        # the merged window's sparse batch histogram (upper-bound key →
+        # count, same encoding as _Rollup.row) so consumers that merge
+        # summaries (history samples, the offline fitter) keep the
+        # mergeable-by-addition property
+        out["batch_hist"] = {
+            str(Histogram.bucket_upper(i)): c
+            for i, c in enumerate(bhist.counts) if c
+        }
         return out
 
     def _annotate_ring(self, op: str, detail: dict) -> None:

@@ -50,6 +50,9 @@ class HookType(enum.Enum):
     # (objective_name, old_state_name, new_state_name, objective_row) on
     # every burn/exhaustion transition
     SERVER_SLO = "server_slo"
+    # telemetry-history anomaly (broker/history.py): fired with
+    # (series_name, sample_value, anomaly_row) on every baseline breach
+    SERVER_ANOMALY = "server_anomaly"
 
 
 @dataclass

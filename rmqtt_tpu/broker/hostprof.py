@@ -247,6 +247,11 @@ class HostProfiler:
             if "incident_max" in kw and int(kw["incident_max"]) != self.incident_max:
                 self.incident_max = max(1, int(kw["incident_max"]))
                 self.incidents = deque(self.incidents, maxlen=self.incident_max)
+            if ("rollup_max" in kw
+                    and max(2, int(kw["rollup_max"])) != self.rollup_max):
+                self.rollup_max = max(2, int(kw["rollup_max"]))
+                self._rollups = deque(self._rollups,
+                                      maxlen=self.rollup_max)
 
     def reset(self) -> None:
         """Drop every counter/ring (tests; the profiler is process-global,
@@ -517,6 +522,37 @@ class HostProfiler:
                 "ts": round(time.time(), 3),
                 "detail": detail,
             })
+
+    def rollup_summary(self, since: Optional[float] = None,
+                       n: Optional[int] = None) -> dict:
+        """Rollup CONSUMER API (devprof's sibling, the history collector's
+        signal source): merge the interval buckets at/after ``since`` (or
+        the newest ``n``; the newest 6 by default) into one window summary
+        — ticks, laggy ticks, lag p50/p99, GC pauses/pause-ms, blocking
+        incidents. Cheaper than ``snapshot()`` (no /proc scan, no incident
+        tables) so a collector can poll it every few seconds."""
+        with self._lock:
+            rolls = list(self._rollups)
+        if since is not None:
+            rolls = [r for r in rolls if r.t + self.interval_s > since]
+        elif n is not None:
+            rolls = rolls[-max(0, n):]
+        else:
+            rolls = rolls[-6:]
+        hist = Histogram()
+        out = {"intervals": len(rolls), "ticks": 0, "laggy": 0,
+               "gc_pauses": 0, "gc_pause_ns": 0, "blocked": 0}
+        for r in rolls:
+            out["ticks"] += r.ticks
+            out["laggy"] += r.laggy
+            out["gc_pauses"] += r.gc_pauses
+            out["gc_pause_ns"] += r.gc_pause_ns
+            out["blocked"] += r.blocked
+            hist.merge(r.hist)
+        out["gc_pause_ms"] = round(out.pop("gc_pause_ns") / 1e6, 3)
+        out["lag_p50_ms"] = round(hist.quantile(0.50) / 1e6, 3)
+        out["lag_p99_ms"] = round(hist.quantile(0.99) / 1e6, 3)
+        return out
 
     # ------------------------------------------------------------- surfaces
     def snapshot(self) -> dict:

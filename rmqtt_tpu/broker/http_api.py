@@ -234,6 +234,7 @@ class HttpApi:
                 "/api/v1/slo", "/api/v1/slo/sum",
                 "/api/v1/device", "/api/v1/device/sum",
                 "/api/v1/host", "/api/v1/host/sum",
+                "/api/v1/history", "/api/v1/history/sum",
                 "/api/v1/overload", "/api/v1/fabric",
                 "/api/v1/durability",
                 "/api/v1/autotune", "/api/v1/autotune/sum",
@@ -449,6 +450,36 @@ class HttpApi:
             from rmqtt_tpu.broker.hostprof import HOSTPROF
 
             return 200, {"node": ctx.node_id, **HOSTPROF.snapshot()}, J
+        if path == "/api/v1/history/sum":
+            # cluster-wide telemetry timeline (broker/history.py): node
+            # timelines align on step buckets (counters sum, quantile/rate
+            # series average, sparse histograms key-add, states take the
+            # worst); anomalies concatenate per-node (what=history DATA
+            # query per peer, forwarding the range/step params)
+            from rmqtt_tpu.broker.history import HistoryService
+
+            params = {"series": q.get("series", [None])[0],
+                      "from": q.get("from", [None])[0],
+                      "to": q.get("to", [None])[0],
+                      "step": q.get("step", [None])[0]}
+            local = ctx.history.query(
+                series=params["series"], frm=params["from"],
+                to=params["to"], step=params["step"])
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "history", **params},
+                lambda r: [r["history"]] if "history" in r else [],
+            )
+            return 200, HistoryService.merge_snapshots(local, peers), J
+        if path == "/api/v1/history":
+            # telemetry-history range query (broker/history.py): the
+            # cross-plane sample timeline + anomaly annotations, filtered
+            # to [from, to], projected to ?series= (comma-separated) and
+            # step-downsampled by ?step= seconds. Shape-stable disabled.
+            return 200, ctx.history.query(
+                series=q.get("series", [None])[0],
+                frm=q.get("from", [None])[0],
+                to=q.get("to", [None])[0],
+                step=q.get("step", [None])[0]), J
         if path == "/api/v1/slo/sum":
             # cluster-wide SLO: per-objective (good, total) pairs sum
             # across nodes (cumulative + both windows), burn rates
@@ -737,6 +768,9 @@ class HttpApi:
         lines.extend(self.ctx.telemetry.prometheus_lines(labels))
         # SLO gauges + good/bad event counters (broker/slo.py)
         lines.extend(self.ctx.slo.prometheus_lines(labels))
+        # telemetry-history counters (broker/history.py): samples recorded
+        # + per-tracked-series anomaly breaches
+        lines.extend(self.ctx.history.prometheus_lines(labels))
         # tracing counters + span-store gauge (broker/tracing.py)
         lines.extend(self.ctx.tracer.prometheus_lines(labels))
         return "\n".join(lines) + "\n"
@@ -803,7 +837,9 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "net_wheel_sessions","net_wheel_timeouts",
  "routing_failover_state",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
- "routing_device_failures","slo_state","slo_transitions","rss_mb"];
+ "routing_device_failures","slo_state","slo_transitions",
+ "history_samples","history_anomalies","history_segments",
+ "history_recovered_rows","rss_mb"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
 // histogram units are ns, rendered as ms)
 const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],

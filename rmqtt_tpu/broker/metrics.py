@@ -213,6 +213,15 @@ class Stats:
         self.net_egress_drains = 0
         self.net_wheel_sessions = 0
         self.net_wheel_timeouts = 0
+        # telemetry-history gauges (broker/history.py), filled by
+        # ServerContext.stats(); zeros with the collector disabled so the
+        # surface stays shape-stable. samples/anomalies are lifetime
+        # counts, segments counts on-disk segment files opened this
+        # process, recovered_rows what the last cold start read back
+        self.history_samples = 0
+        self.history_anomalies = 0
+        self.history_segments = 0
+        self.history_recovered_rows = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

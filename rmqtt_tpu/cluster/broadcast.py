@@ -375,6 +375,15 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             from rmqtt_tpu.broker.hostprof import HOSTPROF
 
             return {"host": HOSTPROF.snapshot()}
+        if what == "history":
+            # per-node telemetry timeline for /api/v1/history/sum
+            # (broker/history.py merge_snapshots: step buckets align,
+            # counters sum, quantile/rate series average, states worst);
+            # the range/series/step params forward so every node answers
+            # the same question
+            return {"history": ctx.history.query(
+                series=body.get("series"), frm=body.get("from"),
+                to=body.get("to"), step=body.get("step"))}
         if what == "traces":
             # trace-API cluster fetch (broker/tracing.py): by id → this
             # node's spans for that trace (the requester stitches);

@@ -411,6 +411,23 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "block_ms": ("host_block_ms", float),
         "lag_storm_n": ("host_lag_storm_n", int),
         "lag_storm_window": ("host_lag_storm_window", float),
+        # devprof/hostprof rollup-ring retention (intervals kept; at the
+        # default 5 s interval 120 rollups = a 10-minute window)
+        "device_rollup_max": ("device_rollup_max", int),
+        "host_rollup_max": ("host_rollup_max", int),
+        # history_* configure the telemetry-history plane
+        # (broker/history.py): fixed-interval cross-plane collector,
+        # bounded sample ring, CRC-framed on-disk segments with
+        # retention, and the EWMA+MAD anomaly annotator
+        "history": ("history_enable", bool),
+        "history_interval_s": ("history_interval_s", float),
+        "history_ring_max": ("history_ring_max", int),
+        "history_dir": ("history_dir", str),
+        "history_segment_rows": ("history_segment_rows", int),
+        "history_retention_segments": ("history_retention_segments", int),
+        "history_anomaly": ("history_anomaly_enable", bool),
+        "history_anomaly_k": ("history_anomaly_k", float),
+        "history_anomaly_warmup": ("history_anomaly_warmup", int),
     }, broker_kwargs)
     # [slo] — the live SLO engine (broker/slo.py): error budgets +
     # multi-window burn rates over the telemetry histograms and drop

@@ -75,6 +75,9 @@ SITES: List[Tuple[str, str]] = [
     ("bridge.egress", "bridge producer sends (kafka/pulsar/nats egress pumps)"),
     ("net.egress", "per-connection coalesced egress flush (the vectored "
                    "write; error = connection drops, its read loop reaps it)"),
+    ("history.collect", "telemetry-history sample collection (delay = a "
+                        "provokable latency step on the history.collect_ms "
+                        "series for anomaly drills)"),
 ]
 
 

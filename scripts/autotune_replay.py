@@ -29,6 +29,14 @@ Usage:
   python scripts/autotune_replay.py .chip_hunt/devprof_cfg*.json
   python scripts/autotune_replay.py BENCH_r0*.json --json
   python scripts/autotune_replay.py dumps/*.json --env   # shell-ready
+  python scripts/autotune_replay.py --history /var/lib/rmqtt/history
+
+``--history <dir>`` replays a broker's recorded telemetry-history
+segments (broker/history.py): the per-sample ``device.*`` window
+summaries — including the mergeable sparse batch histograms — are
+re-assembled into a devprof-snapshot-shaped document and fitted exactly
+like a flight-recorder dump, so a production timeline seeds the next
+process without anyone having saved a dump.
 """
 
 from __future__ import annotations
@@ -37,7 +45,10 @@ import argparse
 import glob
 import json
 import sys
+from pathlib import Path
 from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def _pow2_cover(n: int, cap: int = 64) -> int:
@@ -197,6 +208,41 @@ def knobs_to_env(knobs: Dict[str, Any]) -> Dict[str, str]:
     return env
 
 
+def history_to_doc(dirpath: str) -> Optional[dict]:
+    """Recorded history segments → one devprof-snapshot-shaped doc the
+    fitter consumes unchanged. Each history sample's ``device.*`` block
+    is a disjoint window summary (rollup_summary since the previous
+    sample), so summing across samples — and key-adding the sparse batch
+    histograms — reconstructs the recording's dispatch totals."""
+    from rmqtt_tpu.broker.history import load_dir
+
+    rows, _anomalies, _torn = load_dir(dirpath)
+    rollups: List[dict] = []
+    items = padded = traces = dispatches = 0
+    for r in rows:
+        dv = {k[len("device."):]: v for k, v in r.items()
+              if k.startswith("device.")}
+        if not dv:
+            continue
+        rollups.append({
+            "batch_hist": dv.get("batch_hist") or {},
+            "dispatches": dv.get("dispatches", 0),
+            "items": dv.get("items", 0),
+        })
+        dispatches += int(dv.get("dispatches", 0) or 0)
+        items += int(dv.get("items", 0) or 0)
+        padded += int(dv.get("padded", 0) or 0)
+        traces += int(dv.get("traces", 0) or 0)
+    if not rollups:
+        return None
+    return {
+        "schema": "rmqtt_tpu.history_replay/1",
+        "compile": {"traces": traces, "storms": 0},
+        "dispatch": {"rollups": rollups, "dispatches": dispatches,
+                     "items": items, "padded_items": padded},
+    }
+
+
 def load_docs(paths: List[str]) -> List[dict]:
     docs: List[dict] = []
     for pattern in paths:
@@ -211,14 +257,27 @@ def load_docs(paths: List[str]) -> List[dict]:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("paths", nargs="+",
+    ap.add_argument("paths", nargs="*",
                     help="devprof dumps / bench artifacts / device bodies")
+    ap.add_argument("--history", action="append", default=[],
+                    metavar="DIR",
+                    help="recorded telemetry-history segment dir(s) "
+                         "(broker/history.py) to fit from")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable {knobs, evidence, env}")
     ap.add_argument("--env", action="store_true",
                     help="print shell-ready KEY=VALUE lines only")
     args = ap.parse_args()
+    if not args.paths and not args.history:
+        ap.error("need artifact paths and/or --history <dir>")
     docs = load_docs(args.paths)
+    for d in args.history:
+        doc = history_to_doc(d)
+        if doc is not None:
+            docs.append(doc)
+        else:
+            print(f"warning: {d}: no device samples in history",
+                  file=sys.stderr)
     if not docs:
         print("no readable artifacts", file=sys.stderr)
         return 2

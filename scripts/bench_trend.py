@@ -19,6 +19,15 @@ Usage:
   python scripts/bench_trend.py                 # ./BENCH_r*.json
   python scripts/bench_trend.py --dir /path --tolerance 10
   python scripts/bench_trend.py --json          # machine-readable
+  python scripts/bench_trend.py --from-history /var/lib/rmqtt/history
+
+``--from-history <dir>`` gates against a live broker's RECORDED timeline
+instead of bench artifacts: the telemetry-history segments
+(broker/history.py) are split into equal time windows, each window's
+delivered-message rate becomes a pseudo-round's goodput (p99 rides
+along from ``publish_e2e_p99_ms``), and the same regression gate fires
+on a >tolerance%% drop between the last two windows — production traffic
+as the trend, no bench run required.
 """
 
 from __future__ import annotations
@@ -29,7 +38,10 @@ import json
 import os
 import re
 import sys
+from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 #: goodput keys probed per config entry, most-representative first (the
 #: router-level number is what a broker user gets; raw device otherwise)
@@ -90,7 +102,7 @@ def parse_round(path: str) -> Optional[dict]:
         # block we synthesize a config entry from (cfg15 standalone runs)
         return isinstance(b, dict) and bool(
             b.get("configs") or b.get("autotune_paired")
-            or b.get("egress_paired"))
+            or b.get("egress_paired") or b.get("history_overhead"))
 
     body = art.get("parsed")
     if not usable(body):
@@ -151,6 +163,20 @@ def parse_round(path: str) -> Optional[dict]:
             "syscall_reduction_x": ep.get("syscall_reduction_x"),
             **({"reduced_sizes": True} if ep.get("reduced_sizes") else {}),
         })
+    # cfg17: the collector-on goodput is the tracked number; the pair
+    # ratio (on/off) rides as "speedup" so a creeping collector cost
+    # shows up on the trend even inside the 2% bound
+    hp = body.get("history_overhead")
+    if isinstance(hp, dict):
+        lat = hp.get("latency_ms") if isinstance(
+            hp.get("latency_ms"), dict) else {}
+        body_configs.setdefault("cfg17_history_overhead", {
+            "tpu_topics_per_sec": hp.get("msgs_per_sec_on"),
+            "p99_ms": lat.get("e2e_p99"),
+            "speedup": hp.get("median_pair_ratio"),
+            "overhead_pct": hp.get("overhead_pct"),
+            **({"reduced_sizes": True} if hp.get("reduced_sizes") else {}),
+        })
     configs = {}
     for name, entry in body_configs.items():
         if not isinstance(entry, dict):
@@ -188,6 +214,51 @@ def load_rounds(pattern: str) -> List[dict]:
         if r is not None:
             rounds.append(r)
     rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def rounds_from_history(dirpath: str, windows: int = 6) -> List[dict]:
+    """Recorded history segments → pseudo-rounds for the same trend/gate
+    machinery: the timeline splits into ``windows`` equal spans, each
+    span's average ``messages.delivered.rate`` is that round's goodput
+    (series key ``history_delivered``), its average
+    ``publish_e2e_p99_ms`` the p99."""
+    from rmqtt_tpu.broker.history import load_dir
+
+    rows, _anomalies, _torn = load_dir(dirpath)
+    rows = [r for r in rows if isinstance(r.get("t"), (int, float))]
+    if len(rows) < 2:
+        return []
+    t0, span = rows[0]["t"], max(1e-9, rows[-1]["t"] - rows[0]["t"])
+    buckets: List[List[dict]] = [[] for _ in range(windows)]
+    for r in rows:
+        buckets[min(windows - 1,
+                    int((r["t"] - t0) / span * windows))].append(r)
+
+    def _avg(grp: List[dict], key: str) -> Optional[float]:
+        vals = [g[key] for g in grp
+                if isinstance(g.get(key), (int, float))]
+        return round(sum(vals) / len(vals), 3) if vals else None
+
+    rounds = []
+    for i, grp in enumerate(buckets):
+        if not grp:
+            continue
+        goodput = _avg(grp, "messages.delivered.rate")
+        if goodput is None:
+            continue
+        rounds.append({
+            "round": i,
+            "path": f"history[{i}]",
+            "metric": None,
+            "value": None,
+            "configs": {"history_delivered": {
+                "goodput": goodput,
+                "p99_ms": _avg(grp, "publish_e2e_p99_ms"),
+                "speedup": None,
+                "reduced": False,
+            }},
+        })
     return rounds
 
 
@@ -274,9 +345,23 @@ def main() -> int:
         help="directory holding BENCH_r*.json (default: repo root)")
     ap.add_argument("--tolerance", type=float, default=10.0,
                     help="goodput regression gate in percent (default 10)")
+    ap.add_argument("--from-history", metavar="DIR",
+                    help="gate against recorded telemetry-history "
+                         "segments instead of BENCH_r*.json artifacts")
+    ap.add_argument("--history-windows", type=int, default=6,
+                    help="time windows the history timeline splits into "
+                         "(default 6; each window is one pseudo-round)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
-    rounds = load_rounds(os.path.join(args.dir, "BENCH_r*.json"))
+    if args.from_history:
+        rounds = rounds_from_history(args.from_history,
+                                     max(2, args.history_windows))
+        if not rounds:
+            print(f"no usable history samples in {args.from_history}",
+                  file=sys.stderr)
+            return 2
+    else:
+        rounds = load_rounds(os.path.join(args.dir, "BENCH_r*.json"))
     if not rounds:
         print("no parseable BENCH_r*.json artifacts found", file=sys.stderr)
         return 2

@@ -552,6 +552,44 @@ async def cell_net_egress(site: str, action: str) -> dict:
         await b.stop()
 
 
+async def cell_history(site: str, action: str) -> dict:
+    """history.collect: an armed delay inflates the collector's own
+    ``history.collect_ms`` series past the EWMA+MAD baseline — the
+    provokable latency step. The contract: the breach lands an anomaly
+    row (with the triggering value), the broker keeps serving publishes
+    through the fault window, and collection keeps running after."""
+    b = MqttBroker(ServerContext(BrokerConfig(
+        port=0, history_interval_s=0.5, history_anomaly_k=4.0,
+        history_anomaly_warmup=4)))
+    await b.start()
+    hist = b.ctx.history
+    fp = FAILPOINTS.point(site)
+    base = fp.triggers
+    try:
+        sub = await TestClient.connect(b.port, "cm-h-sub")
+        await sub.subscribe("h/#", qos=1)
+        pub = await TestClient.connect(b.port, "cm-h-pub")
+        for _ in range(hist.anomaly_warmup + 2):  # settle the baseline
+            hist.collect_once()
+        FAILPOINTS.set(site, action)
+        before = sum(hist.anomalies_total.values())
+        hist.collect_once()  # the inflated sample
+        FAILPOINTS.set(site, "off")
+        await pub.publish("h/live", b"x", qos=1)  # broker still serves
+        served = (await sub.recv(timeout=10.0)).payload == b"x"
+        hist.collect_once()  # collection survives the fault
+        anoms = [a for a in hist.anomalies
+                 if a["series"] == "history.collect_ms"]
+        return {"ok": (served and fp.triggers > base
+                       and sum(hist.anomalies_total.values()) > before
+                       and bool(anoms)),
+                "triggers": fp.triggers - base,
+                "anomalies": len(anoms)}
+    finally:
+        FAILPOINTS.clear_all()
+        await b.stop()
+
+
 #: the matrix: every registered site fired at least once under live traffic
 MATRIX = {
     "device.dispatch:error": lambda: cell_device("device.dispatch", "times(3, error)"),
@@ -571,6 +609,8 @@ MATRIX = {
     "storage.torn_write:crash_torture": cell_durability_crash,
     "net.egress:error": lambda: cell_net_egress("net.egress",
                                                 "times(1, error)"),
+    "history.collect:delay": lambda: cell_history("history.collect",
+                                                  "times(1, delay(150))"),
 }
 
 #: tier-1 subset (fast cells — mostly in-proc; the torn-write torture
@@ -579,7 +619,8 @@ MATRIX = {
 FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
                "bridge.egress:error", "cluster.rpc:partition",
                "fabric.submit:error", "storage.fsync:error",
-               "storage.torn_write:crash_torture", "net.egress:error"]
+               "storage.torn_write:crash_torture", "net.egress:error",
+               "history.collect:delay"]
 
 
 async def run_matrix(cells=None) -> dict:

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Render recorded telemetry-history segments offline.
+
+The broker's history plane (rmqtt_tpu/broker/history.py) persists its
+cross-plane sample timeline as CRC-framed segment files
+(``seg-NNNNNNNNNN.hist``) under ``[observability] history_dir``. This
+script reads a directory (or individual segment files) with the same
+frame scanner recovery uses — every intact frame, torn tails dropped —
+and renders the timeline a paged operator wants *after* the incident,
+with no broker running:
+
+  * per-series summary (first/min/mean/max/last) over the tracked and
+    requested series;
+  * a step-downsampled timeline table (the same merge semantics as
+    ``GET /api/v1/history?step=``: numeric avg, ``*_state`` worst,
+    sparse histograms key-add);
+  * the recorded anomaly annotations, each with its correlated
+    devprof/hostprof dump references.
+
+Usage:
+  python scripts/history_report.py /var/lib/rmqtt/history
+  python scripts/history_report.py hist_dir --series publish_e2e_p99_ms,rss_mb
+  python scripts/history_report.py hist_dir --step 60 --json
+
+Exit codes: 0 = rendered, 1 = anomalies recorded, 2 = nothing readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rmqtt_tpu.broker.history import (  # noqa: E402
+    TRACKED_SERIES, _merge_value, load_dir, read_segment,
+)
+
+#: timeline columns when --series is not given (the tracked set, minus
+#: the rates that need two samples to mean anything offline)
+DEFAULT_COLUMNS = ("publish_e2e_p99_ms", "routing_match_p99_ms",
+                   "host_loop_lag_p99_ms", "device.p99_ms", "rss_mb")
+
+
+def load(paths: List[str]) -> tuple:
+    rows: List[dict] = []
+    anomalies: List[dict] = []
+    torn = 0
+    for p in paths:
+        if os.path.isdir(p):
+            r, a, t = load_dir(p)
+        else:
+            r, a, t = read_segment(p)
+        rows.extend(r)
+        anomalies.extend(a)
+        torn += t
+    rows.sort(key=lambda r: r.get("t", 0))
+    anomalies.sort(key=lambda a: a.get("ts", 0))
+    return rows, anomalies, torn
+
+
+def downsample(rows: List[dict], step: float) -> List[dict]:
+    buckets: Dict[int, List[dict]] = {}
+    for r in rows:
+        if isinstance(r.get("t"), (int, float)):
+            buckets.setdefault(int(r["t"] // step), []).append(r)
+    out = []
+    for b in sorted(buckets):
+        grp = buckets[b]
+        keys = {k for r in grp for k in r if k != "t"}
+        row: Dict[str, Any] = {"t": round(b * step, 3), "n": len(grp)}
+        for k in sorted(keys):
+            row[k] = _merge_value(k, [r[k] for r in grp if k in r])
+        out.append(row)
+    return out
+
+
+def series_summary(rows: List[dict], names: List[str]) -> List[dict]:
+    out = []
+    for name in names:
+        vals = [r[name] for r in rows
+                if isinstance(r.get(name), (int, float))]
+        if not vals:
+            continue
+        out.append({
+            "series": name, "n": len(vals),
+            "first": round(vals[0], 3), "min": round(min(vals), 3),
+            "mean": round(sum(vals) / len(vals), 3),
+            "max": round(max(vals), 3), "last": round(vals[-1], 3),
+        })
+    return out
+
+
+def _hms(ts: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts))
+
+
+def render(rows: List[dict], anomalies: List[dict], torn: int,
+           columns: List[str], step: float) -> str:
+    out: List[str] = []
+    if rows:
+        span = rows[-1]["t"] - rows[0]["t"]
+        out.append(
+            f"history report — {len(rows)} sample(s) over "
+            f"{span:.0f}s ({_hms(rows[0]['t'])} → {_hms(rows[-1]['t'])})"
+            + (f", {torn} torn frame(s) dropped" if torn else ""))
+    out.append("")
+    out.append("== series summary ==")
+    hdr = ["series", "n", "first", "min", "mean", "max", "last"]
+    table = [[str(s[k]) for k in hdr] for s in series_summary(
+        rows, sorted(set(columns) | set(TRACKED_SERIES)))]
+    widths = [max(len(h), *(len(t[i]) for t in table)) if table else len(h)
+              for i, h in enumerate(hdr)]
+    out.append("  ".join(h.ljust(w) for h, w in zip(hdr, widths)))
+    for t in table:
+        out.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+
+    out.append("")
+    out.append(f"== timeline (step {step:.0f}s) ==")
+    down = downsample(rows, step)
+    hdr = ["time", "n", *columns]
+    table = []
+    for r in down[-40:]:
+        table.append([_hms(r["t"]), str(r["n"]),
+                      *(str(r.get(c, "·")) for c in columns)])
+    widths = [max(len(h), *(len(t[i]) for t in table)) if table else len(h)
+              for i, h in enumerate(hdr)]
+    out.append("  ".join(h.ljust(w) for h, w in zip(hdr, widths)))
+    for t in table:
+        out.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+
+    out.append("")
+    if anomalies:
+        out.append(f"== anomalies ({len(anomalies)}) ==")
+        for a in anomalies[-20:]:
+            line = (f"  {_hms(a.get('ts', 0))}  {a.get('series')} "
+                    f"{a.get('value')} vs baseline {a.get('baseline')} "
+                    f"({a.get('factor')}x the deviation)")
+            for d in a.get("dumps") or ():
+                line += (f"\n           ↳ {d.get('plane')} dump "
+                         f"({d.get('reason')}): {d.get('path')}")
+            out.append(line)
+    else:
+        out.append("== anomalies == none recorded")
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="history dir(s) and/or seg-*.hist file(s)")
+    ap.add_argument("--series", default=",".join(DEFAULT_COLUMNS),
+                    help="comma-separated timeline columns")
+    ap.add_argument("--step", type=float, default=30.0,
+                    help="downsample bucket in seconds (default 30)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable {samples, anomalies, torn}")
+    args = ap.parse_args()
+    rows, anomalies, torn = load(args.paths)
+    if not rows and not anomalies:
+        print("no readable history frames", file=sys.stderr)
+        return 2
+    columns = [s.strip() for s in args.series.split(",") if s.strip()]
+    if args.json:
+        print(json.dumps({
+            "samples": rows, "anomalies": anomalies, "torn": torn,
+            "downsampled": downsample(rows, max(0.001, args.step)),
+            "summary": series_summary(
+                rows, sorted(set(columns) | set(TRACKED_SERIES))),
+        }, indent=1))
+    else:
+        print(render(rows, anomalies, torn, columns,
+                     max(0.001, args.step)))
+    return 1 if anomalies else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

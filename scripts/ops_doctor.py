@@ -55,6 +55,7 @@ ENDPOINTS = {
     "fabric": ("/api/v1/fabric", None),
     "durability": ("/api/v1/durability", None),
     "cluster": ("/api/v1/cluster", None),
+    "history": ("/api/v1/history", "/api/v1/history/sum"),
 }
 
 
@@ -189,6 +190,20 @@ def diagnose(planes: Dict[str, Any]) -> List[dict]:
                       f"{fallbacks} fabric submit fallback(s) — owner "
                       f"outages degraded publishes to worker-local match"))
 
+    hist = planes.get("history") or {}
+    anomalies = hist.get("anomalies") or []
+    if anomalies:
+        by_series: Dict[str, int] = {}
+        for a in anomalies:
+            by_series[a.get("series", "?")] = (
+                by_series.get(a.get("series", "?"), 0) + 1)
+        worst = max(anomalies, key=lambda a: float(a.get("factor", 0)))
+        out.append(_f("history", "WARN",
+                      f"{len(anomalies)} anomaly annotation(s) on the "
+                      f"timeline ({', '.join(f'{s}x{n}' for s, n in sorted(by_series.items()))}); "
+                      f"worst: {worst.get('series')} {worst.get('value')} "
+                      f"vs baseline {worst.get('baseline')}"))
+
     cl = planes.get("cluster") or {}
     # /api/v1/cluster nests the failure detector under "membership";
     # "peers" is a LIST of per-peer snapshots (cluster/membership.py)
@@ -262,7 +277,42 @@ def _event_phrase(op: dict) -> str:
     if name == "device.retrace_storm":
         return (f"retrace storm ({d.get('traces_in_window', '?')} jit "
                 f"traces)")
+    if name == "history.anomaly":
+        return (f"anomaly {d.get('series')} {d.get('value')} "
+                f"({d.get('factor')}x the baseline deviation)")
     return name
+
+
+def timeline_lines(history: dict, slow_ops: List[dict],
+                   window_s: float = 10.0) -> List[str]:
+    """The history plane's anomaly annotations rendered as a timeline:
+    each breach with its step ratio, the slow-op ring events that
+    PRECEDED it within the window ("3 s after a retrace storm") and the
+    devprof/hostprof dumps the annotator correlated by reference."""
+    lines: List[str] = []
+    for a in (history.get("anomalies") or [])[-10:]:
+        ts = float(a.get("ts", 0))
+        when = time.strftime("%H:%M:%S", time.localtime(ts))
+        val, base = a.get("value"), a.get("baseline")
+        step = ""
+        if (isinstance(val, (int, float)) and isinstance(base, (int, float))
+                and base > 0):
+            step = f" stepped {val / base:.1f}x"
+        head = (f"{a.get('series')}{step or ' anomalous'} at {when} "
+                f"({val} vs baseline {base})")
+        causes: List[str] = []
+        for op in slow_ops:
+            if op.get("op") == "history.anomaly":
+                continue
+            dt = ts - float(op.get("ts", 0))
+            if 0 <= dt <= window_s:
+                causes.append(f"{dt:.0f} s after {_event_phrase(op)}")
+        for d in a.get("dumps") or ():
+            causes.append(f"{d.get('plane')} dump ({d.get('reason')}): "
+                          f"{d.get('path')}")
+        lines.append(head + (" — " + "; ".join(causes[-4:])
+                             if causes else ""))
+    return lines
 
 
 def episode_lines(episodes: List[dict], device_clean: bool) -> List[str]:
@@ -384,6 +434,16 @@ def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
                                for p in peer_rows) or "none")
                   + ")" if cl.get("enabled") else "single node"))
 
+    hist = planes.get("history") or {}
+    pers = hist.get("persistence") or {}
+    out.append(f"[{_status(findings, 'history'):4}] history   "
+               + (f"{hist.get('count', 0)} sample(s) @ "
+                  f"{hist.get('interval_s', '?')}s, "
+                  f"{len(hist.get('anomalies') or [])} anomalies"
+                  + (f", persisted to {pers['dir']}" if pers.get("dir")
+                     else ", memory only")
+                  if hist.get("enabled") else "disabled"))
+
     out.append("")
     if findings:
         out.append("== findings ==")
@@ -405,6 +465,19 @@ def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
         out.extend("  " + ln for ln in lines)
     else:
         out.append("  no correlated episodes in the ring")
+
+    # the recorded timeline: anomaly annotations joined with the events
+    # that preceded them ("p99 stepped 2.1x, 3 s after a retrace storm")
+    out.append("")
+    out.append("== telemetry timeline (history plane) ==")
+    tl = timeline_lines(hist, slow_ops)
+    if tl:
+        out.extend("  " + ln for ln in tl)
+    elif hist.get("enabled"):
+        out.append(f"  {hist.get('count', 0)} sample(s) recorded, "
+                   "no anomaly annotations")
+    else:
+        out.append("  history plane disabled")
     return "\n".join(out), findings
 
 
