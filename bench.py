@@ -1711,6 +1711,171 @@ def run_history_overhead_config(name, rng, reduced):
     return res
 
 
+def run_hotkeys_overhead_config(name, rng, reduced):
+    """Config 18: hot-key attribution sketch overhead (broker/hotkeys.py)
+    on the REAL publish path, cfg17-style order-symmetric paired estimator.
+
+    One live broker pipe; the hot-key plane is ARMED (per-publish
+    Space-Saving + Count-Min offers across all six key spaces, the
+    per-dispatch prefix seam, the per-deliver subscriber seam, plus the
+    live rotation/alert task — exactly what ``[observability] hotkeys``
+    enables) for the ON bursts and fully disarmed (``enabled=False`` +
+    routing seam nulled, the shipped-off configuration) for the OFF
+    bursts. The rotation window runs at 0.5 s here — 60× the 30 s
+    production default — so every armed leg contains real rotations and
+    the measured bound is a deliberate upper estimate of the deployed
+    cost. Quads (off,on,on,off) with min-of-two per condition filter
+    one-sided host spikes; the median pair ratio bounds the enabled cost
+    at ≤2% of e2e burst time (standalone ``--config 18`` exits 1 past
+    the bound so CI can gate on it)."""
+    import asyncio
+
+    from rmqtt_tpu.broker.codec import MqttCodec, packets as pk
+    from rmqtt_tpu.broker.context import BrokerConfig, ServerContext
+    from rmqtt_tpu.broker.server import MqttBroker
+
+    msgs = 6_000 if reduced else 15_000
+    ntopics = 64
+    payload = b"x" * 64
+
+    async def _read_until(reader, codec, ptype):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError(f"peer closed before {ptype.__name__}")
+            for p in codec.feed(data):
+                if isinstance(p, ptype):
+                    return p
+
+    async def _connect(port, cid):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        codec = MqttCodec()
+        writer.write(codec.encode(pk.Connect(client_id=cid, keepalive=600)))
+        await writer.drain()
+        await _read_until(reader, codec, pk.Connack)
+        return reader, writer, codec
+
+    async def _measure():
+        # hotkeys=False at construction: the bench owns arm/disarm
+        b = MqttBroker(ServerContext(BrokerConfig(
+            port=0, hotkeys_enable=False, history_enable=False,
+            allow_anonymous=True)))
+        await b.start()
+        hk = b.ctx.hotkeys
+        samples = 0
+        sr, sw, scodec = await _connect(b.port, "c18-sub")
+        sw.write(scodec.encode(pk.Subscribe(1, [("bench/#", pk.SubOpts(qos=0))])))
+        await sw.drain()
+        await _read_until(sr, scodec, pk.Suback)
+        _pr, pw, pcodec = await _connect(b.port, "c18-pub")
+        frames = [pcodec.encode(pk.Publish(
+            topic=f"bench/t{i}", payload=payload, qos=0))
+            for i in range(ntopics)]
+
+        async def burst(n):
+            t0 = time.perf_counter()
+            sent = got = 0
+            deadline = time.monotonic() + 60.0
+            while sent < n:
+                k = min(64, n - sent)
+                pw.write(b"".join(
+                    frames[(sent + j) % ntopics] for j in range(k)))
+                sent += k
+                if pw.transport.get_write_buffer_size() > 1 << 18:
+                    await pw.drain()
+                while got < sent - 2048:
+                    data = await asyncio.wait_for(
+                        sr.read(1 << 16), deadline - time.monotonic())
+                    if not data:
+                        raise ConnectionError("subscriber closed")
+                    got += sum(1 for p in scodec.feed(data)
+                               if isinstance(p, pk.Publish))
+            await pw.drain()
+            while got < sent:
+                data = await asyncio.wait_for(
+                    sr.read(1 << 16), deadline - time.monotonic())
+                if not data:
+                    raise ConnectionError("subscriber closed")
+                got += sum(1 for p in scodec.feed(data)
+                           if isinstance(p, pk.Publish))
+            return time.perf_counter() - t0
+
+        def arm():
+            hk.enabled = True
+            hk.window_s = 0.5  # 60× production cadence: rotation included
+            b.ctx.routing.hotkeys = hk
+            hk.start()
+
+        async def disarm():
+            nonlocal samples
+            # events the armed legs actually attributed (topics space,
+            # cur+prev windows): the ON legs measured sketches that
+            # really recorded, not a dormant flag check
+            hk.drain()
+            samples += int(hk.spaces["topics"].total())
+            await hk.stop()
+            hk.enabled = False
+            b.ctx.routing.hotkeys = None
+
+        try:
+            await burst(1024)  # warm: codec, cache, deliver path
+            arm()
+            await burst(1024)
+            await disarm()
+            # 512-msg windows, same shape as cfg17: long enough that a
+            # rotation amortizes, short enough for ~15 pairs
+            per = 512
+            pairs = []
+            done = 0
+            while done < msgs:
+                t_off1 = await burst(per)
+                arm()
+                t_on1 = await burst(per)
+                t_on2 = await burst(per)
+                await disarm()
+                t_off2 = await burst(per)
+                pairs.append((min(t_off1, t_off2), min(t_on1, t_on2)))
+                done += 2 * per
+            med_ratio = float(np.median([tn / tf for tf, tn in pairs]))
+            best_off = min(tf for tf, _ in pairs)
+            tele = b.ctx.telemetry
+            lat = {"e2e_p50": tele.p_ms("publish.e2e", 0.50),
+                   "e2e_p99": tele.p_ms("publish.e2e", 0.99)}
+            return (per / best_off, med_ratio, lat, samples,
+                    int(hk.rotations))
+        finally:
+            await hk.stop()
+            hk.enabled = False
+            b.ctx.routing.hotkeys = None
+            await b.stop()
+
+    tps_off, med_ratio, lat, samples, rotations = asyncio.run(_measure())
+    overhead_pct = round((med_ratio - 1.0) * 100.0, 2)
+    res = {
+        "name": name,
+        "path": "broker_e2e_qos0_pipe",
+        "msgs_per_window": msgs,
+        "msgs_per_sec_off": round(tps_off, 1),
+        "msgs_per_sec_on": round(tps_off / med_ratio, 1),
+        "median_pair_ratio": round(med_ratio, 4),
+        "overhead_pct": overhead_pct,
+        "bound_pct": 2.0,
+        "ok": overhead_pct <= 2.0,
+        # sketch offers actually recorded during the armed windows: the
+        # ON legs measured a plane that really attributed traffic
+        "samples_recorded": samples,
+        "rotations": rotations,
+        "window_s": 0.5,
+        "latency_ms": lat,
+        **({"reduced_sizes": True} if reduced else {}),
+    }
+    log(f"[{name}] hotkeys plane OFF {tps_off:.0f} msg/s, median pair "
+        f"ratio {res['median_pair_ratio']}x = {overhead_pct}% overhead "
+        f"(bound 2%, {samples} events, {rotations} rotations) | e2e p50 "
+        f"{lat['e2e_p50']}ms → {'OK' if res['ok'] else 'FAIL'}")
+    return res
+
+
 def run_failover_config(name, rng, reduced):
     """Config 10: device-plane failover soak (broker/failover.py).
 
@@ -2724,16 +2889,18 @@ def main():
             # interleave, segmented tables) must be exercised even in a
             # wedged-chip round, and the artifact carries a number for
             # every config (round 3's fallback skipped 4-5 entirely)
-            return i <= 17
+            return i <= 18
         # on real TPU the default is ALL FIVE baseline configs; cfg6 (the
         # host-side match-result cache), cfg7 (telemetry overhead), cfg8
         # (overload soak), cfg9 (churn soak / delta uploads), cfg11
         # (small-batch stage attribution), cfg12/cfg14 (device/host
         # profiler overhead bounds), cfg13 (fabric-vs-broadcast fan-out),
         # cfg15 (autotune-vs-static shifting regime), cfg16
-        # (coalesced-vs-legacy egress) and cfg17 (history collector
-        # overhead bound) are cheap and always informative
-        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17)
+        # (coalesced-vs-legacy egress), cfg17 (history collector
+        # overhead bound) and cfg18 (hot-key sketch overhead bound) are
+        # cheap and always informative
+        return (i <= 3 or i in (6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17,
+                                18)
                 or args.full or on_tpu)
 
     failures = {}
@@ -2903,6 +3070,13 @@ def main():
 
         guarded("cfg17_history_overhead", cfg17)
 
+    if want(18):
+        def cfg18():
+            return run_hotkeys_overhead_config("cfg18_sketch_overhead",
+                                               rng, reduced)
+
+        guarded("cfg18_sketch_overhead", cfg18)
+
     # cfg6/cfg7/cfg8 have their own shapes (on/off comparisons, no tpu/cpu
     # variants): they ride the artifact under "route_cache" /
     # "telemetry_overhead" / "overload_soak" instead of the configs table
@@ -2918,12 +3092,37 @@ def main():
     autotune_res = results.pop("cfg15_autotune_paired", None)
     egress_res = results.pop("cfg16_egress_paired", None)
     history_res = results.pop("cfg17_history_overhead", None)
+    hotkeys_res = results.pop("cfg18_sketch_overhead", None)
+    if (not results and hotkeys_res is not None and history_res is None
+            and egress_res is None and autotune_res is None
+            and hostprof_res is None and fabric_res is None
+            and devprof_res is None and smallbatch_res is None
+            and failover_res is None and churn_res is None
+            and overload_res is None and tele_res is None
+            and cache_res is None):
+        # a --config 18 run: its own artifact shape; the >2% bound FAILS
+        # the run (exit 1) so CI can gate on the hot-key sketch cost
+        print(json.dumps({
+            "metric": "hotkeys_overhead_pct[cfg18_sketch_overhead]",
+            "value": hotkeys_res["overhead_pct"],
+            "unit": "pct_vs_off",
+            "vs_baseline": hotkeys_res["overhead_pct"],
+            "ok": hotkeys_res["ok"],
+            "samples_recorded": hotkeys_res["samples_recorded"],
+            "platform": platform,
+            "hotkeys_overhead": hotkeys_res,
+            **({"failed_configs": failures} if failures else {}),
+        }))
+        if not hotkeys_res["ok"]:
+            sys.exit(1)
+        return
     if (not results and history_res is not None and egress_res is None
             and autotune_res is None and hostprof_res is None
             and fabric_res is None and devprof_res is None
             and smallbatch_res is None and failover_res is None
             and churn_res is None and overload_res is None
-            and tele_res is None and cache_res is None):
+            and tele_res is None and cache_res is None
+            and hotkeys_res is None):
         # a --config 17 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI can gate on the history-collector cost
         print(json.dumps({
@@ -2945,7 +3144,8 @@ def main():
             and devprof_res is None and smallbatch_res is None
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
-            and cache_res is None and history_res is None):
+            and cache_res is None and history_res is None
+            and hotkeys_res is None):
         # a --config 16 run: its own artifact shape; the ≥5x send-syscall
         # reduction AND ≥1.25x goodput bounds FAIL the run (exit 1) so CI
         # can gate on the coalesced data plane
@@ -2971,7 +3171,8 @@ def main():
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
             and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         # a --config 15 run: its own artifact shape; the ≥1.15x
         # autotune-over-static bound (plus ≥1 adaptation and 0 unrecovered
         # rollbacks) FAILS the run (exit 1) so CI can gate on it
@@ -2995,7 +3196,8 @@ def main():
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
             and cache_res is None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         # a --config 14 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI can gate on the host-profiler cost
         print(json.dumps({
@@ -3016,7 +3218,8 @@ def main():
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
             and hostprof_res is None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         # a --config 13 run: its own artifact shape; the ≥3× cross-worker
         # fan-out bound FAILS the run (exit 1) so CI can gate on it
         print(json.dumps({
@@ -3042,7 +3245,8 @@ def main():
             and failover_res is None and churn_res is None
             and overload_res is None and tele_res is None
             and cache_res is None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         # a --config 12 run: its own artifact shape; the >2% bound FAILS
         # the run (exit 1) so CI and the chip hunter can gate on it
         print(json.dumps({
@@ -3063,7 +3267,8 @@ def main():
             and churn_res is None and overload_res is None
             and tele_res is None and cache_res is None
             and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         # a --config 11 run (chip hunter window): its own artifact shape
         print(json.dumps({
             "metric": "smallbatch_fused_pair_ratio[cfg11_smallbatch_paired]",
@@ -3080,7 +3285,8 @@ def main():
     if (not results and failover_res is not None and churn_res is None
             and overload_res is None and tele_res is None
             and cache_res is None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         sb = failover_res["time_to_switchback_s"]
         no_sb = sb is None
         if no_sb:
@@ -3107,7 +3313,8 @@ def main():
     if (not results and churn_res is not None and overload_res is None
             and tele_res is None and cache_res is None
             and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         print(json.dumps({
             "metric": "delta_upload_reduction[cfg9_churn_soak]",
             "value": churn_res["delta_reduction_x"],
@@ -3124,7 +3331,8 @@ def main():
         return
     if (not results and overload_res is not None and tele_res is None
             and cache_res is None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         print(json.dumps({
             "metric": "overload_p99_bound[cfg8_overload_soak]",
             "value": overload_res["p99_ratio_off_over_on"],
@@ -3139,7 +3347,8 @@ def main():
         return
     if (not results and tele_res is not None and cache_res is None
             and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         print(json.dumps({
             "metric": "telemetry_overhead_pct[cfg7_telemetry_overhead]",
             "value": tele_res["overhead_pct"],
@@ -3154,7 +3363,8 @@ def main():
         }))
         return
     if (not results and cache_res is not None and egress_res is None
-            and history_res is None):
+            and history_res is None
+            and hotkeys_res is None):
         print(json.dumps({
             "metric": "route_cache_speedup[cfg6_cache_zipf]",
             "value": cache_res["zipf"]["speedup_cached"],
@@ -3186,6 +3396,11 @@ def main():
         failures["cfg17_history_overhead"] = (
             f"history collector overhead {history_res['overhead_pct']}% > "
             f"{history_res['bound_pct']}% bound")
+    if hotkeys_res is not None and not hotkeys_res["ok"]:
+        # same contract for the hot-key attribution plane (cfg18)
+        failures["cfg18_sketch_overhead"] = (
+            f"hot-key sketch overhead {hotkeys_res['overhead_pct']}% > "
+            f"{hotkeys_res['bound_pct']}% bound")
 
     # headline = the largest routing config that ran
     if not results:
@@ -3293,6 +3508,11 @@ def main():
         # (broker/history.py)
         **({"history_overhead": history_res}
            if history_res is not None else {}),
+        # hot-key sketch overhead bound (cfg18): armed-vs-disarmed cost
+        # of the [observability] hotkeys knob at 60× production rotation
+        # cadence (broker/hotkeys.py)
+        **({"hotkeys_overhead": hotkeys_res}
+           if hotkeys_res is not None else {}),
         **devprof_embed,
         **({"failed_configs": failures} if failures else {}),
         **({"reduced_sizes": True} if reduced else {}),

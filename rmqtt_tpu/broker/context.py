@@ -191,6 +191,19 @@ class BrokerConfig:
     history_anomaly_enable: bool = True
     history_anomaly_k: float = 6.0  # breach at k x EWMA deviation
     history_anomaly_warmup: int = 8  # samples before a series can breach
+    # hot-key attribution plane (broker/hotkeys.py, same [observability]
+    # section): Space-Saving top-k + Count-Min sketches over publish
+    # topics (count AND bytes), publishing clients, delivering
+    # subscribers and first-segment filter prefixes, epoch-rotated
+    # decay-window pairs, cluster-mergeable /hotkeys/sum, and a
+    # transition-edged top-1-share alert (slow ring + SERVER_HOTKEY).
+    # hotkeys=false starts no task and costs one attribute check/seam.
+    hotkeys_enable: bool = True
+    hotkeys_k: int = 64  # tracked keys per space (Space-Saving k)
+    hotkeys_cms_width: int = 1024  # Count-Min columns (error ~ N/width)
+    hotkeys_cms_depth: int = 4  # Count-Min rows (confidence)
+    hotkeys_window_s: float = 30.0  # decay-window epoch length
+    hotkeys_alert_share: float = 0.4  # top-1 share that pages
     # overload-control subsystem (broker/overload.py, [overload] config
     # section): watermark-driven NORMAL/ELEVATED/CRITICAL states, token-
     # bucket admission, degradation tiers, circuit-broken egress. Disabled
@@ -613,6 +626,15 @@ class ServerContext:
             telemetry=self.telemetry,
             dispatch_probe=_host_dispatch_probe,
         )
+        # hot-key attribution plane (broker/hotkeys.py): streaming
+        # heavy-hitter sketches over topics/clients/prefixes. Constructed
+        # before the history plane (the collector samples its shares);
+        # the routing seam is wired as an attribute so the disabled cost
+        # on the dispatch path is literally one None test.
+        from rmqtt_tpu.broker.hotkeys import HotkeysService
+
+        self.hotkeys = HotkeysService(self, self.cfg)
+        routing.hotkeys = self.hotkeys if self.hotkeys.enabled else None
         # telemetry-history plane (broker/history.py): the cross-plane
         # timeline collector. Constructed last so its collector sees every
         # other plane wired; recovery (history_dir set) runs here,
@@ -691,6 +713,7 @@ class ServerContext:
         self.overload.start()
         self.slo.start()
         self.autotune.start()  # no-op while [routing] autotune = false
+        self.hotkeys.start()  # no-op while [observability] hotkeys = false
         self.history.start()  # no-op while [observability] history = false
         # host-plane profiler: refcounted process-global start (a second
         # in-process broker shares the one sampler); no-op when disabled
@@ -711,6 +734,7 @@ class ServerContext:
         # history first: its collector reads every other plane, so it must
         # stop (and close its open segment cleanly) before they do
         await self.history.stop()
+        await self.hotkeys.stop()
         if self.fabric is not None:
             await self.fabric.stop()
         if self._store_sweep_task is not None:
@@ -873,6 +897,12 @@ class ServerContext:
         s.history_anomalies = hist["anomalies"]
         s.history_segments = hist["segments"]
         s.history_recovered_rows = hist["recovered_rows"]
+        # hot-key attribution gauges (broker/hotkeys.py); zeros while
+        # disabled. Tracked-key counts + counters only — the top-1 SHARE
+        # stays off this surface (/stats/sum sums plain gauges; a summed
+        # ratio lies) and rides the scrape/history instead
+        for k, v in self.hotkeys.stats_block().items():
+            setattr(s, k, v)
         # process RSS (utils/sysmon.py — same probe the overload sampler
         # uses); sums to a cluster memory total in /stats/sum
         from rmqtt_tpu.utils.sysmon import rss_mb

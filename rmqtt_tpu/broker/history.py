@@ -93,6 +93,7 @@ TRACKED_SERIES = (
     "history.collect_ms",
     "rss_mb",
     "publish.received.rate",
+    "hotkeys_top1_share",
 )
 
 #: devprof/hostprof auto-dumps within this many seconds of a breach are
@@ -135,7 +136,7 @@ def _sum_value(key: str, values: List[Any]):
     if key.endswith("_state") or key.endswith("_state_value"):
         return max(nums)
     if (key.endswith(("_ms", "_p50", "_p99", "_ema", ".rate", "_waste",
-                      "_burn"))
+                      "_burn", "_share"))
             or key == "t"):
         return round(sum(nums) / len(nums), 3)
     total = sum(nums)
@@ -282,6 +283,19 @@ class HistoryService:
                       "gc_pauses", "gc_pause_ms", "blocked"):
                 if k in hv:
                     row["host." + k] = hv[k]
+        except Exception:
+            pass
+        # hot-key attribution (broker/hotkeys.py): top-1/top-8 share +
+        # distinct-key estimate per key space — a sudden skew shift
+        # (hotkeys_top1_share is a tracked series) is the earliest
+        # noisy-neighbor signal, often ahead of any latency breach
+        try:
+            hk = getattr(self.ctx, "hotkeys", None)
+            if hk is not None and hk.enabled:
+                hv = hk.history_summary()
+                row["hotkeys_top1_share"] = hv.pop("top1_share", 0.0)
+                for k, v in hv.items():
+                    row["hotkeys." + k] = v
         except Exception:
             pass
         # SLO burn rates per objective (slo_state already rides stats())

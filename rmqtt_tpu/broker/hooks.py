@@ -53,6 +53,10 @@ class HookType(enum.Enum):
     # telemetry-history anomaly (broker/history.py): fired with
     # (series_name, sample_value, anomaly_row) on every baseline breach
     SERVER_ANOMALY = "server_anomaly"
+    # hot-key attribution alert (broker/hotkeys.py): fired with
+    # (space_name, key, alert_row) when a key space's top-1 share
+    # crosses hotkeys_alert_share (transition-edged: once per episode)
+    SERVER_HOTKEY = "server_hotkey"
 
 
 @dataclass

@@ -235,6 +235,7 @@ class HttpApi:
                 "/api/v1/device", "/api/v1/device/sum",
                 "/api/v1/host", "/api/v1/host/sum",
                 "/api/v1/history", "/api/v1/history/sum",
+                "/api/v1/hotkeys", "/api/v1/hotkeys/sum",
                 "/api/v1/overload", "/api/v1/fabric",
                 "/api/v1/durability",
                 "/api/v1/autotune", "/api/v1/autotune/sum",
@@ -480,6 +481,26 @@ class HttpApi:
                 frm=q.get("from", [None])[0],
                 to=q.get("to", [None])[0],
                 step=q.get("step", [None])[0]), J
+        if path == "/api/v1/hotkeys/sum":
+            # fleet-wide hot keys (broker/hotkeys.py): per-space top-k
+            # lists fold under the mergeable-summaries rule (a key absent
+            # from one node contributes that node's floor to count AND
+            # error, keeping the bracket honest); totals/counters sum
+            # (what=hotkeys DATA query per peer, both cluster modes)
+            from rmqtt_tpu.broker.hotkeys import HotkeysService
+
+            local = ctx.hotkeys.snapshot()
+            peers = await _cluster_merge(
+                ctx, M.DATA, {"what": "hotkeys"},
+                lambda r: [r["hotkeys"]] if "hotkeys" in r else [],
+            )
+            return 200, HotkeysService.merge_snapshots(local, peers), J
+        if path == "/api/v1/hotkeys":
+            # hot-key attribution (broker/hotkeys.py): Space-Saving top-k
+            # per key space (topics by count/bytes, publishing clients,
+            # delivering subscribers, filter prefixes, reason:key drops)
+            # over the live decay-window pair. Shape-stable disabled.
+            return 200, ctx.hotkeys.snapshot(), J
         if path == "/api/v1/slo/sum":
             # cluster-wide SLO: per-objective (good, total) pairs sum
             # across nodes (cumulative + both windows), burn rates
@@ -771,6 +792,10 @@ class HttpApi:
         # telemetry-history counters (broker/history.py): samples recorded
         # + per-tracked-series anomaly breaches
         lines.extend(self.ctx.history.prometheus_lines(labels))
+        # hot-key attribution families (broker/hotkeys.py): bounded
+        # space+key-labeled top-k gauges, per-space top-1 share /
+        # distinct estimates, alert + rotation counters
+        lines.extend(self.ctx.hotkeys.prometheus_lines(labels))
         # tracing counters + span-store gauge (broker/tracing.py)
         lines.extend(self.ctx.tracer.prometheus_lines(labels))
         return "\n".join(lines) + "\n"
@@ -797,6 +822,7 @@ _DASHBOARD_HTML = b"""<!doctype html>
 <h2>Device plane</h2><div class="cards" id="device"></div>
 <h2>Autotune</h2><div class="cards" id="autotune"></div>
 <h2>Host plane</h2><div class="cards" id="host"></div>
+<h2>Hot keys</h2><div class="cards" id="hotkeys"></div>
 <h2>Latency</h2><div class="cards" id="latency"></div>
 <h2>Clients</h2><table id="clients"><thead><tr>
 <th>client id</th><th>node</th><th>ip</th><th>protocol</th><th>connected</th>
@@ -839,7 +865,10 @@ const KEYS=["connections","sessions","subscriptions","subscriptions_shared",
  "routing_failovers","routing_switchbacks","routing_failover_host_routed",
  "routing_device_failures","slo_state","slo_transitions",
  "history_samples","history_anomalies","history_segments",
- "history_recovered_rows","rss_mb"];
+ "history_recovered_rows",
+ "hotkeys_topics_tracked","hotkeys_publishers_tracked",
+ "hotkeys_subscribers_tracked","hotkeys_prefixes_tracked",
+ "hotkeys_rotations","hotkeys_alerts","rss_mb"];
 // latency cards: stage -> quantiles shown (fed by /api/v1/latency;
 // histogram units are ns, rendered as ms)
 const LAT_STAGES=[["publish.e2e",["p50","p99"]],["routing.match",["p50","p99"]],
@@ -918,6 +947,17 @@ async function tick(){
    `<div class="card"><div class="v">${esc(hp.fds??0)}</div><div class="k">open fds</div></div>`+
    `<div class="card"><div class="v">${esc(hex.threads??0)}/${esc(hex.queue??0)}</div><div class="k">executor threads/queued</div></div>`+
    `<div class="card"><div class="v">${esc(hp.threads??0)}</div><div class="k">process threads</div></div>`;
+  const hk=await j("/api/v1/hotkeys");
+  const hks=hk.spaces||{};
+  const hkCard=(space,label)=>{const v=hks[space]||{};const top=(v.top||[])[0];
+   return `<div class="card"><div class="v"${v.alerting?' style="color:#b00020"':''}>${top?esc(top.key)+" ("+esc(((top.share??0)*100).toFixed(1))+"%)":"&mdash;"}</div>
+    <div class="k">${esc(label)} (n=${esc(v.total??0)}, ~${esc(v.distinct_est??0)} keys)</div></div>`};
+  document.getElementById("hotkeys").innerHTML=
+   (hk.enabled?"":`<div class="card"><div class="v">off</div><div class="k">hotkeys disabled</div></div>`)+
+   hkCard("topics","hot topic")+hkCard("topic_bytes","hot topic (bytes)")+
+   hkCard("publishers","top publisher")+hkCard("subscribers","top subscriber")+
+   hkCard("prefixes","hot prefix")+hkCard("drops","hot drop key")+
+   `<div class="card"><div class="v"${(hk.alerts_total??0)?' style="color:#b00020"':''}>${esc(hk.alerts_total??0)}</div><div class="k">hotkey alerts (rotations ${esc(hk.rotations??0)})</div></div>`;
   const lat=await j("/api/v1/latency");
   const hs=lat.histograms||{};
   document.getElementById("latency").innerHTML=

@@ -222,6 +222,19 @@ class Stats:
         self.history_anomalies = 0
         self.history_segments = 0
         self.history_recovered_rows = 0
+        # hot-key attribution gauges (broker/hotkeys.py), filled by
+        # ServerContext.stats(); zeros while disabled so the surface
+        # stays shape-stable. *_tracked = Space-Saving entries live in
+        # the current window (<= hotkeys_k), rotations/alerts are
+        # lifetime counts. The top-1 share deliberately does NOT ride
+        # this surface: /stats/sum SUMS plain gauges and a summed ratio
+        # is meaningless — it lives on the scrape and the history rows
+        self.hotkeys_topics_tracked = 0
+        self.hotkeys_publishers_tracked = 0
+        self.hotkeys_subscribers_tracked = 0
+        self.hotkeys_prefixes_tracked = 0
+        self.hotkeys_rotations = 0
+        self.hotkeys_alerts = 0
 
     def to_json(self) -> Dict[str, Union[int, float]]:
         """Gauge dict for the admin surfaces. Most gauges are ints; the

@@ -95,6 +95,11 @@ class RoutingService:
         # ServerContext when [fabric] is enabled; surfaced through stats()
         # so the fabric counters ride every admin plane (None = zeros)
         self.fabric = None
+        # hot-key attribution plane (broker/hotkeys.py), wired by
+        # ServerContext only when enabled: the dispatch seam attributes
+        # automaton work to first-segment prefixes; None keeps the
+        # disabled cost at a single attribute test per dispatch
+        self.hotkeys = None
         # epoch-versioned match-result cache (pre-queue fast path). The
         # cache is only sound for routers that OPT IN via epochs_tracked
         # (their add/remove bump Router.epochs on every mutation); any
@@ -469,6 +474,11 @@ class RoutingService:
         items, groups = self._plan(batch)
         self.dispatches += 1
         self.dispatched_items += len(items)
+        hk = self.hotkeys
+        if hk is not None:
+            # per dispatched (deduplicated) match item: the automaton work
+            # a namespace prefix is responsible for, not raw publish volume
+            hk.on_dispatch_items(items)
         self.batch_size_ema = (
             len(items) if self.dispatches == 1
             else 0.9 * self.batch_size_ema + 0.1 * len(items)

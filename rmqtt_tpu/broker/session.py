@@ -149,11 +149,17 @@ class Session:
         an ``overload.shed`` span so the trace says why it never arrived."""
         if not self.connected and self.limits.session_expiry <= 0:
             self.ctx.metrics.drop("no_session")
+            hk = self.ctx.hotkeys
+            if hk.enabled:  # reason-labeled drops gain a hot-key dimension
+                hk.on_drop("no_session", self.client_id)
             return
         if item.qos == 0 and self.connected and self.ctx.overload.should_shed_qos0(
             self.deliver_queue
         ):
             self.ctx.metrics.drop("shed_qos0")
+            hk = self.ctx.hotkeys
+            if hk.enabled:
+                hk.on_drop("shed_qos0", self.client_id)
             if item.trace is not None:
                 item.trace.add_wall("overload.shed", 0, {
                     "client": self.client_id, "reason": "shed_qos0",
@@ -177,6 +183,9 @@ class Session:
         dropped = self.deliver_queue.push(item, policy)
         if dropped is not None:
             self.ctx.metrics.drop("queue_full")
+            hk = self.ctx.hotkeys
+            if hk.enabled:
+                hk.on_drop("queue_full", self.client_id)
             if dur is not None and dropped.did:
                 # a terminal drop resolves the pending record, or recovery
                 # would resurrect a message the broker chose to shed
@@ -578,6 +587,9 @@ class SessionState:
         if expired:
             self.ctx.metrics.inc("messages.expired")
             self.ctx.metrics.drop("expired")
+            hk = self.ctx.hotkeys
+            if hk.enabled:
+                hk.on_drop("expired", s.client_id)
             if item.did and self.ctx.durability is not None:
                 self.ctx.durability.on_ack(s.client_id, item.did)
             await self.ctx.hooks.fire(HookType.MESSAGE_DROPPED, s.id, msg, "expired")
@@ -625,6 +637,9 @@ class SessionState:
                     msg, self.codec.version, item.retain, rem)
             await self.send_raw(data)
             self.ctx.metrics.inc("messages.delivered")
+            hk = self.ctx.hotkeys
+            if hk.enabled:  # delivering-subscriber attribution seam
+                hk.on_deliver(s.client_id)
             if t_tr:
                 item.trace.add("deliver.send", t_tr,
                                time.perf_counter_ns() - t_tr,
@@ -655,6 +670,9 @@ class SessionState:
         )
         await self.send(pub)
         self.ctx.metrics.inc("messages.delivered")
+        hk = self.ctx.hotkeys
+        if hk.enabled:  # delivering-subscriber attribution seam
+            hk.on_deliver(s.client_id)
         if t_tr:
             item.trace.add("deliver.send", t_tr, time.perf_counter_ns() - t_tr,
                            {"client": s.client_id, "qos": item.qos})
@@ -674,6 +692,9 @@ class SessionState:
             for e in s.out_inflight.due():
                 if not s.out_inflight.mark_retry(e):
                     self.ctx.metrics.drop("retries_exhausted")
+                    hk = self.ctx.hotkeys
+                    if hk.enabled:
+                        hk.on_drop("retries_exhausted", s.client_id)
                     if e.did and self.ctx.durability is not None:
                         # terminal: the broker gave up on this delivery —
                         # recovery must not resurrect it
@@ -850,6 +871,14 @@ class SessionState:
         if p.qos == 2 and p.packet_id in s.in_qos2:
             await self.send(pk.Pubrec(p.packet_id))
             return
+        # hot-key attribution ingress seam (broker/hotkeys.py): topic by
+        # count AND payload bytes, publishing client. After alias
+        # resolution (the key must be the real topic) and the QoS2 dedup
+        # check (a DUP resend is not new traffic), BEFORE admission — a
+        # rate-limited top talker must still attribute
+        hk = self.ctx.hotkeys
+        if hk.enabled:
+            hk.on_publish(p.topic, s.client_id, len(p.payload))
         # per-client publish admission (broker/overload.py token bucket),
         # AFTER alias resolution (the alias table must stay consistent even
         # across refused publishes) and BEFORE the in_qos2 insert so a
@@ -861,6 +890,8 @@ class SessionState:
             from rmqtt_tpu.broker.types import RC_QUOTA_EXCEEDED
 
             self.ctx.metrics.drop("rate_limited")
+            if hk.enabled:
+                hk.on_drop("rate_limited", s.client_id)
             await self.ctx.hooks.fire(
                 HookType.MESSAGE_DROPPED, s.id,
                 Message(topic=p.topic, payload=p.payload, qos=p.qos, from_id=s.id),

@@ -384,6 +384,11 @@ async def handle_common_message(ctx, mtype: str, body, cluster=None, from_node=N
             return {"history": ctx.history.query(
                 series=body.get("series"), frm=body.get("from"),
                 to=body.get("to"), step=body.get("step"))}
+        if what == "hotkeys":
+            # per-node hot-key sketch snapshot for /api/v1/hotkeys/sum
+            # (broker/hotkeys.py merge_snapshots: top-k lists fold under
+            # the mergeable-summaries rule, totals/counters sum)
+            return {"hotkeys": ctx.hotkeys.snapshot()}
         if what == "traces":
             # trace-API cluster fetch (broker/tracing.py): by id → this
             # node's spans for that trace (the requester stitches);

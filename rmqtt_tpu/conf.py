@@ -428,6 +428,16 @@ def load(path: Optional[str] = None, cli: Optional[Dict[str, Any]] = None,
         "history_anomaly": ("history_anomaly_enable", bool),
         "history_anomaly_k": ("history_anomaly_k", float),
         "history_anomaly_warmup": ("history_anomaly_warmup", int),
+        # hotkeys* configure the hot-key attribution plane
+        # (broker/hotkeys.py): Space-Saving top-k + Count-Min sketches
+        # over topics / clients / filter prefixes, epoch-rotated decay
+        # windows and the top-1-share alert
+        "hotkeys": ("hotkeys_enable", bool),
+        "hotkeys_k": ("hotkeys_k", int),
+        "hotkeys_cms_width": ("hotkeys_cms_width", int),
+        "hotkeys_cms_depth": ("hotkeys_cms_depth", int),
+        "hotkeys_window_s": ("hotkeys_window_s", float),
+        "hotkeys_alert_share": ("hotkeys_alert_share", float),
     }, broker_kwargs)
     # [slo] — the live SLO engine (broker/slo.py): error budgets +
     # multi-window burn rates over the telemetry histograms and drop

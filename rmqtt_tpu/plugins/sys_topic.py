@@ -121,6 +121,7 @@ class SysTopicPlugin(Plugin):
                 await self._publish_device()
                 await self._publish_autotune()
                 await self._publish_host()
+                await self._publish_hotkeys()
                 await self._publish_durability()
             await self._publish_slo()
             await self._publish_overload()
@@ -222,6 +223,22 @@ class SysTopicPlugin(Plugin):
         await self._publish(
             f"{self._prefix}/host/incidents", json.dumps(blk).encode()
         )
+
+    async def _publish_hotkeys(self) -> None:
+        """$SYS/brokers/<node>/hotkeys/{topics,clients,prefixes}: the
+        hot-key attribution plane's bounded top-8 views (broker/
+        hotkeys.py) — hot topics by count AND bytes, top publishing /
+        subscribing clients, hot namespace prefixes + the reason:key
+        drop view. Published only while the plane is enabled
+        (hotkeys=false must change nothing, incl. $SYS)."""
+        hk = getattr(self.ctx, "hotkeys", None)
+        if hk is None or not hk.enabled:
+            return
+        for leaf, payload in hk.sys_payloads().items():
+            await self._publish(
+                f"{self._prefix}/hotkeys/{leaf}",
+                json.dumps(payload).encode(),
+            )
 
     async def _publish_durability(self) -> None:
         """$SYS/brokers/<node>/durability: journal health + the last

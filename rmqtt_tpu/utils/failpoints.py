@@ -78,6 +78,9 @@ SITES: List[Tuple[str, str]] = [
     ("history.collect", "telemetry-history sample collection (delay = a "
                         "provokable latency step on the history.collect_ms "
                         "series for anomaly drills)"),
+    ("hotkeys.rotate", "hot-key sketch epoch rotation (a provokable "
+                       "rotation stall/fault — the previous epoch keeps "
+                       "serving while the rotator misbehaves)"),
 ]
 
 

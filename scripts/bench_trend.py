@@ -102,7 +102,8 @@ def parse_round(path: str) -> Optional[dict]:
         # block we synthesize a config entry from (cfg15 standalone runs)
         return isinstance(b, dict) and bool(
             b.get("configs") or b.get("autotune_paired")
-            or b.get("egress_paired") or b.get("history_overhead"))
+            or b.get("egress_paired") or b.get("history_overhead")
+            or b.get("hotkeys_overhead"))
 
     body = art.get("parsed")
     if not usable(body):
@@ -176,6 +177,19 @@ def parse_round(path: str) -> Optional[dict]:
             "speedup": hp.get("median_pair_ratio"),
             "overhead_pct": hp.get("overhead_pct"),
             **({"reduced_sizes": True} if hp.get("reduced_sizes") else {}),
+        })
+    # cfg18: same contract for the hot-key attribution plane — track the
+    # armed goodput and let the pair ratio expose creeping sketch cost
+    ho = body.get("hotkeys_overhead")
+    if isinstance(ho, dict):
+        lat = ho.get("latency_ms") if isinstance(
+            ho.get("latency_ms"), dict) else {}
+        body_configs.setdefault("cfg18_sketch_overhead", {
+            "tpu_topics_per_sec": ho.get("msgs_per_sec_on"),
+            "p99_ms": lat.get("e2e_p99"),
+            "speedup": ho.get("median_pair_ratio"),
+            "overhead_pct": ho.get("overhead_pct"),
+            **({"reduced_sizes": True} if ho.get("reduced_sizes") else {}),
         })
     configs = {}
     for name, entry in body_configs.items():

@@ -590,6 +590,47 @@ async def cell_history(site: str, action: str) -> dict:
         await b.stop()
 
 
+async def cell_hotkeys(site: str, action: str) -> dict:
+    """hotkeys.rotate: an injected rotation fault must not lose the
+    sketch — the contract is that the current window pair keeps serving
+    (the hot key stays queryable), the broker keeps serving publishes
+    through the fault, and rotation resumes once the site clears."""
+    b = MqttBroker(ServerContext(BrokerConfig(port=0)))
+    await b.start()
+    hk = b.ctx.hotkeys
+    fp = FAILPOINTS.point(site)
+    base = fp.triggers
+    try:
+        sub = await TestClient.connect(b.port, "cm-hk-sub")
+        await sub.subscribe("hk/#", qos=0)
+        pub = await TestClient.connect(b.port, "cm-hk-pub")
+        for _ in range(20):
+            await pub.publish("hk/hot", b"x", qos=0)
+        for _ in range(20):
+            await sub.recv(timeout=10.0)
+        FAILPOINTS.set(site, action)
+        faulted = False
+        try:
+            hk.rotate()
+        except Exception:
+            faulted = True  # the provoked rotation fault
+        FAILPOINTS.set(site, "off")
+        view = hk.spaces["topics"].view()  # the pair kept serving
+        still_hot = bool(view["top"]) and view["top"][0]["key"] == "hk/hot"
+        await pub.publish("hk/live", b"y", qos=0)  # broker still serves
+        served = (await sub.recv(timeout=10.0)).payload == b"y"
+        before = hk.rotations
+        hk.rotate()  # rotation resumes after the fault clears
+        return {"ok": (faulted and still_hot and served
+                       and fp.triggers > base
+                       and hk.rotations == before + 1),
+                "triggers": fp.triggers - base,
+                "tracked": len(view["top"])}
+    finally:
+        FAILPOINTS.clear_all()
+        await b.stop()
+
+
 #: the matrix: every registered site fired at least once under live traffic
 MATRIX = {
     "device.dispatch:error": lambda: cell_device("device.dispatch", "times(3, error)"),
@@ -611,6 +652,8 @@ MATRIX = {
                                                 "times(1, error)"),
     "history.collect:delay": lambda: cell_history("history.collect",
                                                   "times(1, delay(150))"),
+    "hotkeys.rotate:error": lambda: cell_hotkeys("hotkeys.rotate",
+                                                 "times(1, error)"),
 }
 
 #: tier-1 subset (fast cells — mostly in-proc; the torn-write torture
@@ -620,7 +663,7 @@ FAST_SUBSET = ["device.dispatch:error", "storage.write:error",
                "bridge.egress:error", "cluster.rpc:partition",
                "fabric.submit:error", "storage.fsync:error",
                "storage.torn_write:crash_torture", "net.egress:error",
-               "history.collect:delay"]
+               "history.collect:delay", "hotkeys.rotate:error"]
 
 
 async def run_matrix(cells=None) -> dict:

@@ -60,8 +60,8 @@ MAX_HOURS = 11.5
 # the small-batch paired estimator (tiny table, many micro dispatches);
 # cfg12 bounds the device-profiler overhead on chip.
 CONFIG_TIMEOUT = {1: 1500, 2: 2400, 3: 4200, 4: 7200, 5: 7200, 11: 1800,
-                  12: 1800, 15: 2400, 16: 1800, 17: 1800}
-CONFIG_ORDER = (1, 2, 3, 11, 12, 15, 16, 17, 4, 5)  # cheap + diagnostic before 10M
+                  12: 1800, 15: 2400, 16: 1800, 17: 1800, 18: 1800}
+CONFIG_ORDER = (1, 2, 3, 11, 12, 15, 16, 17, 18, 4, 5)  # cheap + diagnostic before 10M
 
 #: --autotune: seed each config's knob env from the accumulated devprof
 #: evidence (scripts/autotune_replay.py) instead of defaults

@@ -56,6 +56,7 @@ ENDPOINTS = {
     "durability": ("/api/v1/durability", None),
     "cluster": ("/api/v1/cluster", None),
     "history": ("/api/v1/history", "/api/v1/history/sum"),
+    "hotkeys": ("/api/v1/hotkeys", "/api/v1/hotkeys/sum"),
 }
 
 
@@ -204,6 +205,17 @@ def diagnose(planes: Dict[str, Any]) -> List[dict]:
                       f"worst: {worst.get('series')} {worst.get('value')} "
                       f"vs baseline {worst.get('baseline')}"))
 
+    hk = planes.get("hotkeys") or {}
+    for space, sv in (hk.get("spaces") or {}).items():
+        if not isinstance(sv, dict):
+            continue
+        top = (sv.get("top") or [{}])[0]
+        if sv.get("alerting"):
+            out.append(_f("hotkeys", "WARN",
+                          f"{space} top key {top.get('key')!r} holds "
+                          f"{top.get('share', 0):.0%} of {sv.get('total', 0)} "
+                          f"event(s) — noisy-neighbor share alert"))
+
     cl = planes.get("cluster") or {}
     # /api/v1/cluster nests the failure detector under "membership";
     # "peers" is a LIST of per-peer snapshots (cluster/membership.py)
@@ -227,10 +239,10 @@ def correlate(slow_ops: List[dict],
     → episodes [{ts, events: [...], slow_stages: [...]}]."""
     anchors = [op for op in slow_ops
                if str(op.get("op", "")).split(".")[0] in
-               ("host", "overload", "slo", "device", "autotune")]
+               ("host", "overload", "slo", "device", "autotune", "hotkeys")]
     stages = [op for op in slow_ops
               if str(op.get("op", "")).split(".")[0] not in
-              ("host", "overload", "slo", "device", "autotune")]
+              ("host", "overload", "slo", "device", "autotune", "hotkeys")]
     episodes: List[dict] = []
     for anchor in anchors:
         ts = float(anchor.get("ts", 0))
@@ -280,6 +292,11 @@ def _event_phrase(op: dict) -> str:
     if name == "history.anomaly":
         return (f"anomaly {d.get('series')} {d.get('value')} "
                 f"({d.get('factor')}x the baseline deviation)")
+    if name == "hotkeys.alert":
+        share = d.get("share", 0)
+        return (f"hot key {d.get('key')!r} at "
+                f"{share * 100 if isinstance(share, (int, float)) else 0:.0f}"
+                f"% of {d.get('space')} traffic")
     return name
 
 
@@ -313,6 +330,36 @@ def timeline_lines(history: dict, slow_ops: List[dict],
         lines.append(head + (" — " + "; ".join(causes[-4:])
                              if causes else ""))
     return lines
+
+
+def hotkey_lines(hotkeys: dict, top_n: int = 5) -> List[str]:
+    """The "who is hot" section: per key space, the top keys with their
+    share and error bracket (count is an overestimate by at most err —
+    the Space-Saving guarantee survives the /sum merge). Pure, renders
+    live-node and /sum bodies alike."""
+    if not hotkeys.get("enabled"):
+        return ["  hotkeys plane disabled"]
+    lines: List[str] = []
+    labels = (("topics", "hot topics"),
+              ("topic_bytes", "hot topics by bytes"),
+              ("publishers", "top publishing clients"),
+              ("subscribers", "top subscriber clients"),
+              ("prefixes", "hot namespace prefixes"),
+              ("drops", "hot drop keys (reason:client)"))
+    for space, label in labels:
+        sv = (hotkeys.get("spaces") or {}).get(space) or {}
+        top = sv.get("top") or []
+        if not top:
+            continue
+        flag = " [ALERTING]" if sv.get("alerting") else ""
+        lines.append(f"  {label}{flag} (n={sv.get('total', 0)}, "
+                     f"~{sv.get('distinct_est', 0)} distinct):")
+        for ent in top[:top_n]:
+            share = (ent.get("share") or 0) * 100
+            lines.append(f"    {ent.get('key')!r:40}  "
+                         f"{ent.get('count', 0)} (±{ent.get('err', 0)}) "
+                         f"{share:.1f}%")
+    return lines or ["  no traffic recorded yet"]
 
 
 def episode_lines(episodes: List[dict], device_clean: bool) -> List[str]:
@@ -444,6 +491,23 @@ def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
                      else ", memory only")
                   if hist.get("enabled") else "disabled"))
 
+    hk = planes.get("hotkeys") or {}
+    hks = hk.get("spaces") or {}
+
+    def _hk_top1(space: str) -> str:
+        sv = hks.get(space) or {}
+        top = (sv.get("top") or [{}])[0]
+        if not top.get("key"):
+            return f"{space} —"
+        return (f"{space} {top['key']!r} "
+                f"{(top.get('share') or 0) * 100:.0f}%")
+
+    out.append(f"[{_status(findings, 'hotkeys'):4}] hotkeys   "
+               + ("; ".join(_hk_top1(s) for s in
+                            ("topics", "publishers", "prefixes"))
+                  + f"; {hk.get('alerts_total', 0)} alert(s)"
+                  if hk.get("enabled") else "disabled"))
+
     out.append("")
     if findings:
         out.append("== findings ==")
@@ -465,6 +529,13 @@ def render(planes: Dict[str, Any]) -> Tuple[str, List[dict]]:
         out.extend("  " + ln for ln in lines)
     else:
         out.append("  no correlated episodes in the ring")
+
+    # who is hot: the attribution plane's top-k per key space — the
+    # "which topic / which client / which prefix" answer next to the
+    # aggregate planes that only say "something is hot"
+    out.append("")
+    out.append("== who is hot (hot-key attribution) ==")
+    out.extend(hotkey_lines(hk))
 
     # the recorded timeline: anomaly annotations joined with the events
     # that preceded them ("p99 stepped 2.1x, 3 s after a retrace storm")

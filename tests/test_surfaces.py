@@ -270,6 +270,9 @@ def test_full_scrape_grammar_all_planes(tmp_path):
                 "rmqtt_host_gc_pauses_total",
                 "rmqtt_overload_state", "rmqtt_durability_appends",
                 "rmqtt_failpoint_triggers_total",
+                "rmqtt_hotkeys_topk", "rmqtt_hotkeys_top1_share",
+                "rmqtt_hotkeys_alerts_total",
+                "rmqtt_hotkeys_rotations_total",
                 "rmqtt_uptime_seconds", "rmqtt_build_info",
             ):
                 assert family in text, f"family {family} missing"
